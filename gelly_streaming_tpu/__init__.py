@@ -1,0 +1,36 @@
+"""gelly_streaming_tpu — a TPU-native streaming graph analytics framework.
+
+A ground-up re-design of the capabilities of `gelly-streaming`
+(single-pass graph streaming on Flink) for TPU hardware: a thin
+host-side stream driver feeds tumbling-window COO edge batches to
+XLA-compiled JAX/Pallas kernels; summaries merge across chips with
+collectives over a `jax.sharding.Mesh`.
+
+Layers (SURVEY.md §1):
+- core/     stream driver: env, DataStream slice, GraphStream API, time
+- ops/      device kernels: segment folds, neighborhoods, triangles, union-find
+- models/   algorithm library + workloads (CC, bipartiteness, triangles, …)
+- parallel/ multi-chip: mesh, shard_map merge-tree, collectives
+- utils/    aggregate state types (DisjointSet, Candidates, events)
+- io/       sources/sinks
+"""
+
+from .core.datastream import DataStream
+from .core.env import StreamEnvironment
+from .core.functions import (EdgesApply, EdgesFold, EdgesReduce,
+                             JaxEdgesApply, JaxEdgesFold, JaxEdgesReduce)
+from .core.graphstream import GraphStream, GraphWindowStream, SimpleEdgeStream
+from .core.gtime import (AscendingTimestampExtractor, ManualClock, SystemClock,
+                         Time, TimeCharacteristic)
+from .core.types import NULL, Edge, EdgeDirection, NullValue, Vertex
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "DataStream", "StreamEnvironment", "EdgesApply", "EdgesFold",
+    "EdgesReduce", "JaxEdgesApply", "JaxEdgesFold", "JaxEdgesReduce",
+    "GraphStream", "GraphWindowStream", "SimpleEdgeStream",
+    "AscendingTimestampExtractor", "ManualClock", "SystemClock", "Time",
+    "TimeCharacteristic", "NULL", "Edge", "EdgeDirection", "NullValue",
+    "Vertex",
+]

@@ -1,0 +1,99 @@
+"""Neighborhood UDF interfaces.
+
+Host forms mirror the reference's three UDF interfaces exactly
+(EdgesFold.java:46, EdgesReduce.java:42, EdgesApply.java:47).
+Jax* forms are their device-compiled counterparts: jax-traceable
+functions lowered to segment kernels (ops/segment.py) — the TPU-native
+way to run a neighborhood aggregation as one XLA program per window.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+
+class EdgesFold:
+    """Host fold UDF: fn(accum, vertex_id, neighbor_id, edge_value) -> accum
+    (reference: EdgesFold.java:46)."""
+
+    def __init__(self, fn: Callable[[Any, Any, Any, Any], Any] = None):
+        self._fn = fn
+
+    def fold_edges(self, accum, vertex_id, neighbor_id, edge_value):
+        if self._fn is None:
+            raise NotImplementedError
+        return self._fn(accum, vertex_id, neighbor_id, edge_value)
+
+
+class EdgesReduce:
+    """Host reduce UDF: fn(a, b) -> value (reference: EdgesReduce.java:42)."""
+
+    def __init__(self, fn: Callable[[Any, Any], Any] = None):
+        self._fn = fn
+
+    def reduce_edges(self, a, b):
+        if self._fn is None:
+            raise NotImplementedError
+        return self._fn(a, b)
+
+
+class EdgesApply:
+    """Host apply UDF: fn(vertex_id, neighbors, collect) with `neighbors`
+    an iterable of (neighbor_id, edge_value) (reference: EdgesApply.java:47)."""
+
+    def __init__(self, fn: Callable[[Any, Any, Callable], None] = None):
+        self._fn = fn
+
+    def apply_on_edges(self, vertex_id, neighbors, collect):
+        if self._fn is None:
+            raise NotImplementedError
+        return self._fn(vertex_id, neighbors, collect)
+
+
+# ----------------------------------------------------------------------
+# device-compiled forms
+# ----------------------------------------------------------------------
+
+class JaxEdgesFold:
+    """Device fold: jax-traceable fn(acc_tree, vertex_id, neighbor_id,
+    edge_value) -> acc_tree over scalars; `init` is the accumulator pytree.
+
+    Runs as a segmented `lax.scan` in arrival order — semantics identical
+    to the reference's incremental pane fold (GraphWindowStream.java:77-80),
+    compiled once per shape bucket.
+    """
+
+    def __init__(self, init, fn, emit=None):
+        self.init = init
+        self.fn = fn
+        # optional host-side post-map from (vertex_id, acc_tree) to the
+        # emitted record; default emits the accumulator tuple itself.
+        self.emit = emit
+
+
+class JaxEdgesReduce:
+    """Device reduce of neighborhood edge values.
+
+    `name` selects a fully-parallel monoid kernel ('sum'|'min'|'max');
+    otherwise `fn(a, b)` runs as a segmented scan in arrival order.
+    """
+
+    def __init__(self, fn=None, name: Optional[str] = None):
+        if fn is None and name is None:
+            raise ValueError("need fn or name")
+        self.fn = fn
+        self.name = name
+
+
+class JaxEdgesApply:
+    """Device apply over a padded neighborhood view.
+
+    fn(vertex_id, neighbor_ids[max_deg], edge_values[max_deg],
+       mask[max_deg]) -> output pytree of fixed shape; vmapped over the
+    window's vertices. For variable-arity outputs use the host EdgesApply
+    or a fused workload kernel (ops/triangles.py).
+    """
+
+    def __init__(self, fn, emit=None):
+        self.fn = fn
+        self.emit = emit

@@ -1,0 +1,243 @@
+"""Windowed neighborhood kernels: per-window columnar execution of
+foldNeighbors / reduceOnEdges / applyOnNeighbors
+(reference: GraphWindowStream.java:62-182).
+
+Each kernel consumes one tumbling window's edges (already
+direction-transformed so key = source, neighbor = target, matching the
+reference's slice(): SimpleEdgeStream.java:153-171), converts them to
+COO arrays, interns vertex ids, and runs a device segment kernel
+(ops/segment.py). Host fallbacks reproduce the reference's per-record
+semantics for arbitrary Python UDFs.
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import OrderedDict
+from typing import Any, Callable, List, Tuple
+
+import numpy as np
+
+from ..core.functions import (EdgesApply, EdgesFold, EdgesReduce,
+                              JaxEdgesApply, JaxEdgesFold, JaxEdgesReduce)
+from . import segment as seg_ops
+
+Record = Tuple[Any, int]
+
+
+def _window_arrays(edges) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """COO arrays (key=source, neighbor=target, value) for a window batch."""
+    src = np.asarray([e.source for e in edges])
+    dst = np.asarray([e.target for e in edges])
+    values = [e.value for e in edges]
+    if all(isinstance(v, int) and not isinstance(v, bool) for v in values):
+        val = np.asarray(values, dtype=np.int64)
+    else:
+        try:
+            val = np.asarray(values, dtype=np.float64)
+        except (TypeError, ValueError):
+            val = np.zeros(len(edges), np.int32)  # NullValue edges
+    return src, dst, val
+
+
+def _group_by_key(edges) -> "OrderedDict[Any, List[Tuple[Any, Any]]]":
+    """Arrival-order neighborhood grouping (host path)."""
+    groups: "OrderedDict[Any, List]" = OrderedDict()
+    for e in edges:
+        groups.setdefault(e.source, []).append((e.target, e.value))
+    return groups
+
+
+def _py(x):
+    """numpy scalar → python scalar for sink formatting."""
+    if isinstance(x, np.generic):
+        return x.item()
+    return x
+
+
+# ----------------------------------------------------------------------
+# fold
+# ----------------------------------------------------------------------
+
+def make_fold_kernel(fold) -> Callable[[List[Any], int], List[Record]]:
+    if isinstance(fold, JaxEdgesFold):
+        return _device_fold_kernel(fold)
+    if isinstance(fold, tuple):  # (initial, EdgesFold)
+        initial, fold_udf = fold
+        return _host_fold_kernel(initial, fold_udf)
+    raise TypeError(type(fold))
+
+
+def _host_fold_kernel(initial, fold_udf: EdgesFold):
+    def kernel(edges, wmax) -> List[Record]:
+        out: List[Record] = []
+        accs: "OrderedDict[Any, Any]" = OrderedDict()
+        for e in edges:
+            acc = accs.get(e.source)
+            if acc is None:
+                acc = copy.deepcopy(initial)
+            accs[e.source] = fold_udf.fold_edges(acc, e.source, e.target, e.value)
+        for _key, acc in accs.items():
+            out.append((acc, wmax))
+        return out
+
+    return kernel
+
+
+def _device_fold_kernel(fold: JaxEdgesFold):
+    fold_fn = fold.fn  # bind once: stable identity keys the jit cache
+
+    def kernel(edges, wmax) -> List[Record]:
+        src, dst, val = _window_arrays(edges)
+        uniq, (s_dense,) = seg_ops.intern(src)
+        order = np.argsort(s_dense, kind="stable")
+        fields = (src[order], dst[order], val[order])
+        result, has_any = seg_ops.segmented_fold(
+            fold_fn, fold.init, s_dense[order], fields, len(uniq)
+        )
+        out: List[Record] = []
+        leaves = [np.asarray(l) for l in _tree_leaves(result)]
+        for i, vid in enumerate(uniq):
+            if not has_any[i]:
+                continue
+            row = tuple(_py(l[i]) for l in leaves)
+            value = fold.emit(_py(vid), row) if fold.emit else row
+            out.append((value, wmax))
+        return out
+
+    return kernel
+
+
+def _tree_leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+# ----------------------------------------------------------------------
+# reduce
+# ----------------------------------------------------------------------
+
+def make_reduce_kernel(reduce_udf) -> Callable[[List[Any], int], List[Record]]:
+    if isinstance(reduce_udf, JaxEdgesReduce):
+        return _device_reduce_kernel(reduce_udf)
+    if isinstance(reduce_udf, EdgesReduce):
+        return _host_reduce_kernel(reduce_udf)
+    raise TypeError(type(reduce_udf))
+
+
+def _host_reduce_kernel(reduce_udf: EdgesReduce):
+    def kernel(edges, wmax) -> List[Record]:
+        groups = _group_by_key(edges)
+        out: List[Record] = []
+        for key, nbrs in groups.items():
+            acc = nbrs[0][1]
+            for _n, v in nbrs[1:]:
+                acc = reduce_udf.reduce_edges(acc, v)
+            # result projected to (vertexId, value) — reference
+            # GraphWindowStream.java:103 `.project(0,2)`
+            out.append(((key, acc), wmax))
+        return out
+
+    return kernel
+
+
+def _device_reduce_kernel(reduce_udf: JaxEdgesReduce):
+    name = reduce_udf.name
+    fn = reduce_udf.fn
+
+    def kernel(edges, wmax) -> List[Record]:
+        src, _dst, val = _window_arrays(edges)
+        uniq, (s_dense,) = seg_ops.intern(src)
+        n_seg = len(uniq)
+        if name in ("sum", "min", "max"):
+            import jax.numpy as jnp
+
+            nb = seg_ops.bucket_size(len(val))
+            sb = seg_ops.bucket_size(n_seg)
+            vpad = seg_ops.pad_to(val, nb)
+            spad = seg_ops.pad_to(s_dense.astype(np.int32), nb, fill=sb)
+            res = np.asarray(
+                seg_ops.segment_reduce(jnp.asarray(vpad), jnp.asarray(spad),
+                                       sb + 1, name)
+            )[:n_seg]
+            has_any = np.ones(n_seg, bool)
+        else:
+            order = np.argsort(s_dense, kind="stable")
+            res, has_any = seg_ops.segmented_reduce(
+                fn, s_dense[order], val[order], n_seg
+            )
+            res = np.asarray(res)
+        return [
+            ((_py(uniq[i]), _py(res[i])), wmax)
+            for i in range(n_seg) if has_any[i]
+        ]
+
+    return kernel
+
+
+# ----------------------------------------------------------------------
+# apply
+# ----------------------------------------------------------------------
+
+def make_apply_kernel(apply_udf) -> Callable[[List[Any], int], List[Record]]:
+    if isinstance(apply_udf, JaxEdgesApply):
+        return _device_apply_kernel(apply_udf)
+    if isinstance(apply_udf, EdgesApply):
+        return _host_apply_kernel(apply_udf)
+    raise TypeError(type(apply_udf))
+
+
+def _host_apply_kernel(apply_udf: EdgesApply):
+    """Buffered whole-neighborhood apply, 0..n outputs per vertex
+    (reference: EdgesWindowFunction, GraphWindowStream.java:135-182)."""
+
+    def kernel(edges, wmax) -> List[Record]:
+        groups = _group_by_key(edges)
+        out: List[Record] = []
+        for key, nbrs in groups.items():
+            apply_udf.apply_on_edges(key, nbrs, lambda v: out.append((v, wmax)))
+        return out
+
+    return kernel
+
+
+def _device_apply_kernel(apply_udf: JaxEdgesApply):
+    """Padded-CSR neighborhood view vmapped over vertices."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = apply_udf.fn
+    vmapped = jax.jit(jax.vmap(fn))
+
+    def kernel(edges, wmax) -> List[Record]:
+        src, dst, val = _window_arrays(edges)
+        uniq, (s_dense,) = seg_ops.intern(src)
+        order = np.argsort(s_dense, kind="stable")
+        s_sorted = s_dense[order]
+        n_seg = len(uniq)
+        counts = np.bincount(s_sorted, minlength=n_seg)
+        max_deg = seg_ops.bucket_size(int(counts.max()) if n_seg else 1)
+        nbr = np.zeros((n_seg, max_deg), dtype=np.int64)
+        vals = np.zeros((n_seg, max_deg), dtype=val.dtype)
+        mask = np.zeros((n_seg, max_deg), dtype=bool)
+        starts = np.zeros(n_seg + 1, np.int64)
+        np.cumsum(counts, out=starts[1:])
+        dst_sorted = np.asarray(dst)[order]
+        val_sorted = val[order]
+        for i in range(n_seg):
+            c = counts[i]
+            nbr[i, :c] = dst_sorted[starts[i]:starts[i] + c]
+            vals[i, :c] = val_sorted[starts[i]:starts[i] + c]
+            mask[i, :c] = True
+        res = vmapped(jnp.asarray(np.asarray(uniq)), jnp.asarray(nbr),
+                      jnp.asarray(vals), jnp.asarray(mask))
+        leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(res)]
+        out: List[Record] = []
+        for i in range(n_seg):
+            row = tuple(_py(l[i]) for l in leaves)
+            value = apply_udf.emit(_py(uniq[i]), row) if apply_udf.emit else row
+            out.append((value, wmax))
+        return out
+
+    return kernel

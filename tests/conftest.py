@@ -1,0 +1,60 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): in-process
+"mini-cluster" — here a virtual 8-device CPU mesh via
+`--xla_force_host_platform_device_count`, the JAX analog of Flink's
+multi-subtask single-JVM StreamingProgramTestBase — with golden-output
+comparison of sorted result lines.
+"""
+
+import os
+
+# Must run before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+from gelly_streaming_tpu import Edge, ManualClock, StreamEnvironment  # noqa: E402
+
+
+@pytest.fixture
+def env():
+    """Deterministic environment: manual ingestion clock pinned at 0 so a
+    whole finite source lands in one window (the reference gets this from
+    fast fromCollection ingestion; ConnectedComponentsTest.java:62 pins
+    parallelism=1 for the same determinism)."""
+    return StreamEnvironment(clock=ManualClock(0))
+
+
+def long_long_edges():
+    """The canonical 5-vertex/7-edge weighted test graph
+    (reference: GraphStreamTestUtils.java:56-67)."""
+    return [
+        Edge(1, 2, 12),
+        Edge(1, 3, 13),
+        Edge(2, 3, 23),
+        Edge(3, 4, 34),
+        Edge(3, 5, 35),
+        Edge(4, 5, 45),
+        Edge(5, 1, 51),
+    ]
+
+
+def run_and_sort(env, stream):
+    """Execute and return sorted formatted lines — the reference's
+    `compareResultsByLinesInMemory` idiom (TestSlice.java:53-55)."""
+    from gelly_streaming_tpu.core.types import csv_line
+
+    sink = stream.collect()
+    env.execute()
+    return sorted(csv_line(v) for v in env.results_of(sink))
+
+
+@pytest.fixture
+def sample_edges():
+    return long_long_edges()

@@ -1,0 +1,167 @@
+"""Stream transformation parity tests.
+
+Golden outputs from the reference's operation ITs
+(test/operations/*.java — SURVEY.md §4): creation, getVertices,
+getDegrees ×3, numberOfVertices/Edges, mapEdges (incl. type-changing and
+chained), filterEdges/filterVertices (simple/keep-all/discard-all),
+distinct, reverse, undirected, union.
+"""
+
+from gelly_streaming_tpu import Edge, SimpleEdgeStream
+
+from ..conftest import long_long_edges, run_and_sort
+
+
+def _graph(env):
+    return SimpleEdgeStream(env.from_collection(long_long_edges()), env)
+
+
+def test_graph_stream_creation(env):
+    # reference: TestGraphStreamCreation.java:60-66
+    assert run_and_sort(env, _graph(env).get_edges()) == sorted(
+        ["1,2,12", "1,3,13", "2,3,23", "3,4,34", "3,5,35", "4,5,45", "5,1,51"]
+    )
+
+
+def test_get_vertices(env):
+    # reference: TestGetVertices.java:61-65
+    assert run_and_sort(env, _graph(env).get_vertices()) == sorted(
+        ["1,(null)", "2,(null)", "3,(null)", "4,(null)", "5,(null)"]
+    )
+
+
+def test_get_degrees(env):
+    # reference: TestGetDegrees.java:68-81
+    assert run_and_sort(env, _graph(env).get_degrees()) == sorted(
+        ["1,1", "1,2", "1,3", "2,1", "2,2", "3,1", "3,2", "3,3", "3,4",
+         "4,1", "4,2", "5,1", "5,2", "5,3"]
+    )
+
+
+def test_get_in_degrees(env):
+    # reference: TestGetDegrees.java:94-100
+    assert run_and_sort(env, _graph(env).get_in_degrees()) == sorted(
+        ["1,1", "2,1", "3,1", "3,2", "4,1", "5,1", "5,2"]
+    )
+
+
+def test_get_out_degrees(env):
+    # reference: TestGetDegrees.java:113-119
+    assert run_and_sort(env, _graph(env).get_out_degrees()) == sorted(
+        ["1,1", "1,2", "2,1", "3,1", "3,2", "4,1", "5,1"]
+    )
+
+
+def test_number_of_vertices(env):
+    # reference: TestNumberOfEntities.java:73-77
+    assert run_and_sort(env, _graph(env).number_of_vertices()) == sorted(
+        ["1", "2", "3", "4", "5"]
+    )
+
+
+def test_number_of_edges(env):
+    # reference: TestNumberOfEntities.java:96-102
+    assert run_and_sort(env, _graph(env).number_of_edges()) == sorted(
+        ["1", "2", "3", "4", "5", "6", "7"]
+    )
+
+
+def test_map_edges(env):
+    # reference: TestMapEdges.java:71-77 (add one to each value)
+    mapped = _graph(env).map_edges(lambda e: e.value + 1)
+    assert run_and_sort(env, mapped.get_edges()) == sorted(
+        ["1,2,13", "1,3,14", "2,3,24", "3,4,35", "3,5,36", "4,5,46", "5,1,52"]
+    )
+
+
+def test_map_edges_to_tuple_type(env):
+    # reference: TestMapEdges.java:99-105 (value type Long → Tuple2)
+    mapped = _graph(env).map_edges(lambda e: (e.value, e.value + 1))
+    assert run_and_sort(env, mapped.get_edges()) == sorted(
+        ["1,2,(12,13)", "1,3,(13,14)", "2,3,(23,24)", "3,4,(34,35)",
+         "3,5,(35,36)", "4,5,(45,46)", "5,1,(51,52)"]
+    )
+
+
+def test_chained_maps(env):
+    # reference: TestMapEdges.java:129-135
+    mapped = _graph(env).map_edges(lambda e: e.value + 1).map_edges(
+        lambda e: (e.value, e.value + 1)
+    )
+    assert run_and_sort(env, mapped.get_edges()) == sorted(
+        ["1,2,(13,14)", "1,3,(14,15)", "2,3,(24,25)", "3,4,(35,36)",
+         "3,5,(36,37)", "4,5,(46,47)", "5,1,(52,53)"]
+    )
+
+
+def test_filter_edges(env):
+    # reference: TestFilterEdges.java:70-74 (value > 20)
+    filtered = _graph(env).filter_edges(lambda e: e.value > 20)
+    assert run_and_sort(env, filtered.get_edges()) == sorted(
+        ["2,3,23", "3,4,34", "3,5,35", "4,5,45", "5,1,51"]
+    )
+
+
+def test_filter_edges_keep_all(env):
+    # reference: TestFilterEdges.java:99-105
+    filtered = _graph(env).filter_edges(lambda e: True)
+    assert len(run_and_sort(env, filtered.get_edges())) == 7
+
+
+def test_filter_edges_discard_all(env):
+    # reference: TestFilterEdges.java:128
+    filtered = _graph(env).filter_edges(lambda e: False)
+    assert run_and_sort(env, filtered.get_edges()) == []
+
+
+def test_filter_vertices(env):
+    # reference: TestFilterVertices.java:70-73 (id > 1 on both endpoints)
+    filtered = _graph(env).filter_vertices(lambda v: v.id > 1)
+    assert run_and_sort(env, filtered.get_edges()) == sorted(
+        ["2,3,23", "3,4,34", "3,5,35", "4,5,45"]
+    )
+
+
+def test_filter_vertices_keep_all(env):
+    filtered = _graph(env).filter_vertices(lambda v: True)
+    assert len(run_and_sort(env, filtered.get_edges())) == 7
+
+
+def test_filter_vertices_discard_all(env):
+    filtered = _graph(env).filter_vertices(lambda v: False)
+    assert run_and_sort(env, filtered.get_edges()) == []
+
+
+def test_distinct(env):
+    # reference: TestDistinct.java:69-75 (doubled edge list deduped)
+    doubled = long_long_edges() + long_long_edges()
+    stream = SimpleEdgeStream(env.from_collection(doubled), env).distinct()
+    assert run_and_sort(env, stream.get_edges()) == sorted(
+        ["1,2,12", "1,3,13", "2,3,23", "3,4,34", "3,5,35", "4,5,45", "5,1,51"]
+    )
+
+
+def test_reverse(env):
+    # reference: TestReverse.java:62-68
+    assert run_and_sort(env, _graph(env).reverse().get_edges()) == sorted(
+        ["2,1,12", "3,1,13", "3,2,23", "4,3,34", "5,3,35", "5,4,45", "1,5,51"]
+    )
+
+
+def test_undirected(env):
+    # reference: TestUndirected.java:62-75
+    assert run_and_sort(env, _graph(env).undirected().get_edges()) == sorted(
+        ["1,2,12", "2,1,12", "1,3,13", "3,1,13", "2,3,23", "3,2,23",
+         "3,4,34", "4,3,34", "3,5,35", "5,3,35", "4,5,45", "5,4,45",
+         "5,1,51", "1,5,51"]
+    )
+
+
+def test_union(env):
+    # reference: TestUnion.java:80-86 (split then union restores the graph)
+    edges = long_long_edges()
+    first = SimpleEdgeStream(env.from_collection(edges[:4]), env)
+    second = SimpleEdgeStream(env.from_collection(edges[4:]), env)
+    assert run_and_sort(env, first.union(second).get_edges()) == sorted(
+        ["1,2,12", "1,3,13", "2,3,23", "3,4,34", "3,5,35", "4,5,45", "5,1,51"]
+    )

@@ -1,0 +1,119 @@
+"""Slice + neighborhood aggregation parity tests.
+
+Golden outputs from the reference's TestSlice.java:81-229 — all 9 cases
+({fold, reduce, apply} × {OUT, IN, ALL}) — each run through BOTH the
+host UDF path and the device (JAX segment-kernel) path.
+"""
+
+import pytest
+
+from gelly_streaming_tpu import (Edge, EdgeDirection, EdgesApply, EdgesFold,
+                                 EdgesReduce, JaxEdgesApply, JaxEdgesFold,
+                                 JaxEdgesReduce, SimpleEdgeStream, Time)
+
+from ..conftest import long_long_edges, run_and_sort
+
+FOLD_EXPECTED = {
+    # reference TestSlice.java:81-121
+    EdgeDirection.OUT: ["1,25", "2,23", "3,69", "4,45", "5,51"],
+    EdgeDirection.IN: ["1,51", "2,12", "3,36", "4,34", "5,80"],
+    EdgeDirection.ALL: ["1,76", "2,35", "3,105", "4,79", "5,131"],
+}
+
+APPLY_EXPECTED = {
+    # reference TestSlice.java:189-229. Note: the reference file lists
+    # "2,big" for ALL (TestSlice.java:226), which contradicts its own
+    # fold-ALL golden "2,35" (TestSlice.java:118) — the apply iterator
+    # (GraphWindowStream.java:157-159) exposes exactly the fold's
+    # (neighbor, value) pairs, and 35 ≤ 50 ⇒ "small". The reference
+    # harness never actually asserts the earlier tables (only the last
+    # expectedResult assignment survives to postSubmit), so we pin the
+    # self-consistent value here.
+    EdgeDirection.OUT: ["1,small", "2,small", "3,big", "4,small", "5,big"],
+    EdgeDirection.IN: ["1,big", "2,small", "3,small", "4,small", "5,big"],
+    EdgeDirection.ALL: ["1,big", "2,small", "3,big", "4,big", "5,big"],
+}
+
+DIRECTIONS = [EdgeDirection.OUT, EdgeDirection.IN, EdgeDirection.ALL]
+
+
+def _graph(env):
+    return SimpleEdgeStream(env.from_collection(long_long_edges()), env)
+
+
+@pytest.mark.parametrize("direction", DIRECTIONS)
+def test_fold_neighbors_host(env, direction):
+    fold = EdgesFold(lambda acc, vid, nid, val: (vid, acc[1] + val))
+    sums = _graph(env).slice(Time.seconds(1), direction).fold_neighbors(
+        (0, 0), fold
+    )
+    assert run_and_sort(env, sums) == sorted(FOLD_EXPECTED[direction])
+
+
+@pytest.mark.parametrize("direction", DIRECTIONS)
+def test_fold_neighbors_device(env, direction):
+    import jax.numpy as jnp
+
+    fold = JaxEdgesFold(
+        init=(jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)),
+        fn=lambda acc, vid, nid, val: (vid, acc[1] + val),
+    )
+    sums = _graph(env).slice(Time.seconds(1), direction).fold_neighbors(fold)
+    assert run_and_sort(env, sums) == sorted(FOLD_EXPECTED[direction])
+
+
+@pytest.mark.parametrize("direction", DIRECTIONS)
+def test_reduce_on_edges_host(env, direction):
+    sums = _graph(env).slice(Time.seconds(1), direction).reduce_on_edges(
+        EdgesReduce(lambda a, b: a + b)
+    )
+    assert run_and_sort(env, sums) == sorted(FOLD_EXPECTED[direction])
+
+
+@pytest.mark.parametrize("direction", DIRECTIONS)
+@pytest.mark.parametrize("spec", ["named", "generic"])
+def test_reduce_on_edges_device(env, direction, spec):
+    reduce_udf = (JaxEdgesReduce(name="sum") if spec == "named"
+                  else JaxEdgesReduce(fn=lambda a, b: a + b))
+    sums = _graph(env).slice(Time.seconds(1), direction).reduce_on_edges(reduce_udf)
+    assert run_and_sort(env, sums) == sorted(FOLD_EXPECTED[direction])
+
+
+@pytest.mark.parametrize("direction", DIRECTIONS)
+def test_apply_on_neighbors_host(env, direction):
+    def classify(vid, neighbors, collect):
+        total = sum(v for _n, v in neighbors)
+        collect((vid, "big" if total > 50 else "small"))
+
+    out = _graph(env).slice(Time.seconds(1), direction).apply_on_neighbors(
+        EdgesApply(classify)
+    )
+    assert run_and_sort(env, out) == sorted(APPLY_EXPECTED[direction])
+
+
+@pytest.mark.parametrize("direction", DIRECTIONS)
+def test_apply_on_neighbors_device(env, direction):
+    import jax.numpy as jnp
+
+    apply_udf = JaxEdgesApply(
+        fn=lambda vid, nbrs, vals, mask: jnp.sum(jnp.where(mask, vals, 0)),
+        emit=lambda vid, row: (vid, "big" if row[0] > 50 else "small"),
+    )
+    out = _graph(env).slice(Time.seconds(1), direction).apply_on_neighbors(apply_udf)
+    assert run_and_sort(env, out) == sorted(APPLY_EXPECTED[direction])
+
+
+def test_multiple_windows_event_time(env):
+    """Windowing splits neighborhoods by event time (Flink TimeWindow
+    semantics: start = ts - ts % size; result ts = window end - 1)."""
+    from gelly_streaming_tpu import AscendingTimestampExtractor
+
+    edges = [Edge(1, 2, 10), Edge(1, 3, 20), Edge(1, 4, 120)]
+    stream = SimpleEdgeStream(
+        env.from_collection(edges), env,
+        timestamp_extractor=AscendingTimestampExtractor(lambda e: e.value),
+    )
+    sums = stream.slice(Time.milliseconds_of(100)).reduce_on_edges(
+        EdgesReduce(lambda a, b: a + b)
+    )
+    assert run_and_sort(env, sums) == ["1,120", "1,30"]
