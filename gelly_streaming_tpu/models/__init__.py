@@ -1,0 +1,10 @@
+"""Algorithm library + workloads (the reference's L4 `library/` plus the
+aggregate state types' algorithmic backends)."""
+
+from .bipartiteness import BipartitenessCheck, TpuBipartitenessCheck
+from .connected_components import ConnectedComponents, TpuConnectedComponents
+
+__all__ = [
+    "BipartitenessCheck", "TpuBipartitenessCheck",
+    "ConnectedComponents", "TpuConnectedComponents",
+]

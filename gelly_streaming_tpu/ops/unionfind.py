@@ -1,0 +1,94 @@
+"""Array union-find: min-label propagation with pointer jumping.
+
+The device-side replacement for the reference's pointer-chasing
+`DisjointSet` hot loop (example/util/DisjointSet.java:71-123, the
+per-edge `find`/`union` in UpdateCC, library/ConnectedComponents.java:87-90)
+and for `Candidates`' O(C²·V) merge (example/util/Candidates.java:76-138):
+
+- `cc_labels`: per-window weakly-connected-component labels for a COO
+  edge batch as one XLA program — scatter-min both directions plus
+  `labels = labels[labels]` compression inside a `lax.while_loop`,
+  converging in O(log diameter) rounds.
+- `bipartite_labels`: 2-coloring via the bipartite double cover — the
+  graph is bipartite iff (v,+) and (v,−) never share a component —
+  which reduces bipartiteness to the same cc kernel (idiomatic
+  vectorizable replacement per SURVEY.md §7 step 4).
+
+Padded edge slots must point at the sentinel vertex `num_vertices`.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import segment as seg_ops
+
+
+@functools.partial(jax.jit, static_argnames=("num_vertices",))
+def cc_labels(src: jax.Array, dst: jax.Array, num_vertices: int) -> jax.Array:
+    """Labels[v] = smallest vertex index in v's component.
+
+    src/dst: int32 [E] with padding slots set to `num_vertices`.
+    Returns int32 [num_vertices + 1] (last row is the padding sentinel).
+    """
+    labels0 = jnp.arange(num_vertices + 1, dtype=jnp.int32)
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        m = jnp.minimum(labels[src], labels[dst])
+        new = labels.at[src].min(m).at[dst].min(m)
+        # pointer jumping: jump each label to its label's label
+        new = new[new]
+        return new, jnp.any(new != labels)
+
+    labels, _ = jax.lax.while_loop(cond, body, (labels0, jnp.array(True)))
+    return labels
+
+
+def connected_components(src: np.ndarray, dst: np.ndarray,
+                         num_vertices: int) -> np.ndarray:
+    """Host wrapper: pads to buckets and returns labels[:num_vertices]."""
+    e = len(src)
+    eb = seg_ops.bucket_size(e)
+    vb = seg_ops.bucket_size(num_vertices)
+    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)
+    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)
+    labels = np.asarray(cc_labels(jnp.asarray(s), jnp.asarray(d), vb))
+    # bucket-padding vertices are isolated; compress to true vertex range
+    return labels[:num_vertices]
+
+
+def bipartite_labels(src: np.ndarray, dst: np.ndarray, num_vertices: int):
+    """2-coloring via the double cover.
+
+    Returns (labels[num_vertices], signs[num_vertices], odd[num_vertices]):
+    `labels` are component labels of the underlying graph, `signs` the
+    side of the bipartition relative to the component's minimum vertex,
+    and `odd[v]` True iff v's component contains an odd cycle.
+    """
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    v = num_vertices
+    # double cover: (u,+)=u, (u,-)=u+v; edge u~w joins (u,+)-(w,-), (u,-)-(w,+)
+    s2 = np.concatenate([src, src + v])
+    d2 = np.concatenate([dst + v, dst])
+    lab2 = connected_components(s2, d2, 2 * v)
+    plus, minus = lab2[:v], lab2[v:]
+    odd = plus == minus
+    # For a bipartite component with min vertex m: the (+) cover of m's
+    # side and the (−) cover of the other side form one cover component
+    # whose min index is m itself; the other cover component's min index
+    # is the other side's min vertex m2 > m. Hence both cover labels are
+    # < v, their min is the component's min vertex, and a vertex is on
+    # the root's side iff its (+) cover carries the smaller label.
+    labels = np.minimum(plus, minus)
+    signs = plus <= minus
+    return labels, signs, odd

@@ -1,0 +1,42 @@
+"""DisjointSet unit tests — parity with the reference's only pure unit
+test (DisjointSetTest.java:31-77)."""
+
+from gelly_streaming_tpu.utils.disjoint_set import DisjointSet
+
+
+def _even_odd_set():
+    ds = DisjointSet()
+    for i in range(8):
+        ds.union(i, i + 2)
+    return ds
+
+
+def test_get_matches_size():
+    assert len(_even_odd_set().get_matches()) == 10
+
+
+def test_find_two_parities():
+    ds = _even_odd_set()
+    root1, root2 = ds.find(0), ds.find(1)
+    assert root1 != root2
+    for i in range(10):
+        assert ds.find(i) == (root1 if i % 2 == 0 else root2)
+
+
+def test_merge():
+    ds = _even_odd_set()
+    ds2 = DisjointSet()
+    for i in range(8):
+        ds2.union(i, i + 100)
+    ds2.merge(ds)
+    assert len(ds2.get_matches()) == 18
+    roots = {ds2.find(e) for e in ds2.get_matches()}
+    assert len(roots) == 2
+
+
+def test_repr_component_format():
+    ds = DisjointSet()
+    ds.union(1, 2)
+    ds.union(8, 9)
+    # reference toString prints {root=[members...]} (DisjointSet.java:139-153)
+    assert repr(ds) == "{1=[1, 2], 8=[8, 9]}"
