@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""Streaming bipartiteness check.
+
+Usage: bipartiteness_check.py [<input edges path> <output path>
+       [merge window ms] [--tpu]]
+
+Mirrors the reference CLI (example/BipartitenessCheckExample.java:44-80,
+default merge window 500 ms); `--tpu` selects the double-cover device
+kernel.
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    from gelly_streaming_tpu.core.platform import use_cpu
+    use_cpu()
+
+from gelly_streaming_tpu import Edge, NULL, SimpleEdgeStream, StreamEnvironment
+from gelly_streaming_tpu.models import (BipartitenessCheck,
+                                        TpuBipartitenessCheck)
+
+
+def main(argv):
+    tpu = "--tpu" in argv
+    argv = [a for a in argv if a != "--tpu"]
+    env = StreamEnvironment.get_execution_environment()
+    if argv:
+        edges = env.read_text_file(argv[0]).map(
+            lambda l: Edge(int(l.split()[0]), int(l.split()[1]), NULL)
+        )
+        out_path = argv[1] if len(argv) > 1 else None
+        merge_ms = int(argv[2]) if len(argv) > 2 else 500
+    else:
+        print("Executing with built-in default data.")
+        edges = env.from_collection([
+            Edge(1, 2, NULL), Edge(1, 3, NULL), Edge(1, 4, NULL),
+            Edge(4, 5, NULL), Edge(4, 7, NULL), Edge(4, 9, NULL),
+        ])
+        out_path, merge_ms = None, 500
+
+    graph = SimpleEdgeStream(edges, env)
+    algo = TpuBipartitenessCheck(merge_ms) if tpu else BipartitenessCheck(merge_ms)
+    result = graph.aggregate(algo)
+    if out_path:
+        result.write_as_text(out_path)
+    else:
+        result.print_()
+    env.execute("Bipartiteness check")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Broadcast sampling triangle-count estimate.
+
+Usage: broadcast_triangle_count.py [<input path> <output path>
+       <vertex count> <sample size> [parallelism]]
+
+Mirrors the reference CLI (example/BroadcastTriangleCount.java:219-239:
+defaults samples=1000).
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    from gelly_streaming_tpu.core.platform import use_cpu
+    use_cpu()
+
+from gelly_streaming_tpu import Edge, NULL, StreamEnvironment
+from gelly_streaming_tpu.models.sampling_triangles import \
+    broadcast_triangle_count
+
+DEFAULT_EDGES = [(1, 2), (2, 3), (1, 3), (3, 4), (3, 5), (4, 5)]
+
+
+def main(argv):
+    env = StreamEnvironment.get_execution_environment()
+    if len(argv) >= 4:
+        edges = env.read_text_file(argv[0]).map(
+            lambda l: Edge(int(l.split()[0]), int(l.split()[1]), NULL)
+        )
+        out_path = argv[1]
+        vertices = int(argv[2])
+        samples = int(argv[3])
+        parallelism = int(argv[4]) if len(argv) > 4 else 1
+    else:
+        print("Executing with built-in default data.")
+        edges = env.from_collection([Edge(s, t, NULL) for s, t in DEFAULT_EDGES])
+        out_path, vertices, samples, parallelism = None, 5, 1000, 1
+
+    estimates = broadcast_triangle_count(edges, samples, vertices, parallelism)
+    if out_path:
+        estimates.write_as_csv(out_path)
+    else:
+        estimates.print_()
+    env.execute("Broadcast triangle count")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

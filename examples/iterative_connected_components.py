@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""Iterative (feedback-loop) connected components.
+
+Usage: iterative_connected_components.py [<input path> <output path>] [--tpu]
+
+Mirrors the reference CLI (example/IterativeConnectedComponents.java:45-63);
+`--tpu` runs the in-step while_loop label propagation instead of the
+feedback queue.
+"""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    from gelly_streaming_tpu.core.platform import use_cpu
+    use_cpu()
+
+import numpy as np
+
+from gelly_streaming_tpu import Edge, StreamEnvironment
+from gelly_streaming_tpu.models.iterative_cc import (
+    TpuIterativeConnectedComponents, iterative_connected_components)
+
+DEFAULT_EDGES = [(1, 2), (1, 3), (2, 3), (1, 5), (6, 7), (8, 9)]
+
+
+def main(argv):
+    tpu = "--tpu" in argv
+    argv = [a for a in argv if a != "--tpu"]
+    if argv:
+        with open(argv[0]) as f:
+            pairs = [tuple(int(x) for x in l.split()[:2]) for l in f if l.strip()]
+        out_path = argv[1] if len(argv) > 1 else None
+    else:
+        print("Executing with built-in default data.")
+        pairs, out_path = DEFAULT_EDGES, None
+
+    if tpu:
+        model = TpuIterativeConnectedComponents()
+        src = np.array([p[0] for p in pairs])
+        dst = np.array([p[1] for p in pairs])
+        updates = model.process_batch(src, dst)
+        lines = [f"({v},{c})" for v, c in updates]
+    else:
+        env = StreamEnvironment.get_execution_environment()
+        edges = env.from_collection([(s, t) for s, t in pairs])
+        result = iterative_connected_components(edges)
+        sink = result.collect()
+        env.execute("Iterative connected components")
+        lines = [f"({v},{c})" for v, c in env.results_of(sink)]
+
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write("\n".join(lines) + "\n")
+    else:
+        print("\n".join(lines))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,38 @@
+"""Backend/platform selection helpers.
+
+The TPU-tunnel PJRT plugin in this environment registers itself in
+every interpreter and is initialized even when JAX_PLATFORMS=cpu, so a
+CPU-only run can still block on the (single) hardware chip. `use_cpu()`
+pins a hermetic CPU backend — used by tests and by example CLIs when
+the hardware isn't wanted; `cpu_mesh(n)` additionally requests an
+n-device virtual host platform for sharding tests (must be called
+before jax creates a backend).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def use_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from jax._src import xla_bridge
+
+        for name in [n for n in xla_bridge._backend_factories if n != "cpu"]:
+            xla_bridge._backend_factories.pop(name, None)
+    except Exception:
+        pass
+
+
+def cpu_mesh(n_devices: int = 8) -> None:
+    """Virtual n-device CPU platform (the multi-chip test rig)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    use_cpu()

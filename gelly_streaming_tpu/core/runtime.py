@@ -58,12 +58,33 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self) -> Dict[int, List[Record]]:
+        # Run iteration loops first: their fixpoint evaluation memoizes
+        # every body node's accumulated output, so no sink path can later
+        # re-execute a stateful body operator with already-mutated state.
+        for sink in self.env._sinks:
+            for node in self._ancestors(sink):
+                if node.kind == "iterate":
+                    self.eval(node)
         results: Dict[int, List[Record]] = {}
         for sink in self.env._sinks:
             records = self.eval(sink.parents[0])
             results[sink.id] = records
             self._emit(sink, records)
         return results
+
+    def _ancestors(self, node: OpNode) -> List[OpNode]:
+        seen, order, stack = set(), [], [node]
+        while stack:
+            n = stack.pop()
+            if n.id in seen:
+                continue
+            seen.add(n.id)
+            order.append(n)
+            stack.extend(n.parents)
+            fb = n.params.get("feedback")
+            if fb is not None:
+                stack.append(fb)
+        return order
 
     def _emit(self, sink: OpNode, records: List[Record]) -> None:
         mode = sink.params["mode"]

@@ -1,0 +1,122 @@
+"""Iterative connected components.
+
+Two designs for the reference's feedback-loop CC
+(example/IterativeConnectedComponents.java:52-168):
+
+- `iterative_connected_components` — the reference's shape: a stream
+  iteration whose body (`AssignComponents`) keeps per-key component
+  sets, relabels on merges, and re-emits relabeled (vertex, component)
+  records into the feedback edge until quiescence.
+
+- `TpuIterativeConnectedComponents` — the TPU-native replacement
+  (SURVEY.md §7 "streaming iteration"): no feedback queue; each batch
+  runs min-label propagation to the fixpoint *inside* one device
+  program (`lax.while_loop`, ops/unionfind.cc_labels) with labels
+  carried across batches as device-resident state. Same fixpoint,
+  compiler-friendly schedule.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Set
+
+import numpy as np
+
+from ..core.datastream import DataStream
+from ..ops import segment as seg_ops
+from ..ops import unionfind
+
+
+class AssignComponents:
+    """Stateful component assigner (reference:
+    IterativeConnectedComponents.java:67-168). Input records are
+    (vertex, vertex) edges — including fed-back (vertex, component)
+    relabels; emits (vertex, component) updates."""
+
+    def __init__(self):
+        self._components: Dict[int, Set[int]] = {}
+        self._comp_of: Dict[int, int] = {}
+
+    def __call__(self, edge, collect) -> None:
+        source, target = edge[0], edge[1]
+        source_comp = self._comp_of.get(source, -1)
+        target_comp = self._comp_of.get(target, -1)
+
+        if source_comp != -1 and target_comp != -1:
+            if source_comp != target_comp:
+                self._merge(source_comp, target_comp, collect)
+        elif source_comp != -1:
+            self._add_to_existing(source_comp, target, collect)
+        elif target_comp != -1:
+            self._add_to_existing(target_comp, source, collect)
+        else:
+            self._create(source, target, collect)
+
+    def _set_comp(self, comp: int, vertices: Set[int]) -> None:
+        self._components[comp] = vertices
+        for v in vertices:
+            self._comp_of[v] = comp
+
+    def _create(self, source: int, target: int, collect) -> None:
+        comp = min(source, target)
+        self._set_comp(comp, {source, target})
+        collect((source, comp))
+        collect((target, comp))
+
+    def _add_to_existing(self, comp: int, to_add: int, collect) -> None:
+        vertices = self._components.pop(comp)
+        if comp >= to_add:
+            # the new vertex id becomes the component id: relabel everyone
+            for v in vertices:
+                collect((v, to_add))
+            vertices.add(to_add)
+            self._set_comp(to_add, vertices)
+        else:
+            vertices.add(to_add)
+            self._set_comp(comp, vertices)
+            collect((to_add, comp))
+
+    def _merge(self, source_comp: int, target_comp: int, collect) -> None:
+        src_set = self._components.pop(source_comp)
+        trg_set = self._components.pop(target_comp)
+        comp = min(source_comp, target_comp)
+        relabeled = trg_set if comp == source_comp else src_set
+        for v in relabeled:
+            collect((v, comp))
+        src_set |= trg_set
+        self._set_comp(comp, src_set)
+
+
+def iterative_connected_components(edges: DataStream,
+                                   max_iterations: int = 1000) -> DataStream:
+    """Feedback-loop CC (reference: IterativeConnectedComponents.java:56-58):
+    relabel records re-enter the loop until no more updates."""
+    iteration = edges.iterate(max_iterations=max_iterations)
+    result = iteration.key_by(0).flat_map(AssignComponents())
+    iteration.close_with(result)
+    return result
+
+
+class TpuIterativeConnectedComponents:
+    """In-step while_loop label propagation with carried labels."""
+
+    def __init__(self):
+        self._labels: Dict[int, int] = {}
+
+    def process_batch(self, src: np.ndarray, dst: np.ndarray):
+        """Union a batch of edges into the carried labeling; returns the
+        (vertex, label) pairs that changed."""
+        # fold carried labels in as extra (vertex → label) edges so
+        # cross-batch merges happen inside the same device program
+        carried = np.array(list(self._labels.items()), dtype=np.int64)
+        all_src = np.concatenate([src, carried[:, 0]]) if len(carried) else src
+        all_dst = np.concatenate([dst, carried[:, 1]]) if len(carried) else dst
+        uniq, (s, d) = seg_ops.intern(all_src, all_dst)
+        labels = unionfind.connected_components(s, d, len(uniq))
+        roots = uniq[labels]
+        changed = []
+        for v, root in zip(uniq.tolist(), roots.tolist()):
+            if self._labels.get(v) != root:
+                self._labels[v] = root
+                changed.append((v, root))
+        return changed

@@ -1,0 +1,146 @@
+"""Exact per-window triangle counting kernels.
+
+Device lowering of the reference's WindowTriangles pipeline
+(example/WindowTriangles.java:61-66: slice(ALL) → per-vertex candidate
+generation (O(d²), :83-116) → keyBy(pair) window count (:119-140) →
+global sum). The reference counts each triangle once via its minimum
+vertex: a candidate pair (b,c) emitted from vertex a (with b,c > a)
+scores iff a real edge b~c is present.
+
+TPU-native replacements (same count, no per-record shuffles):
+
+- `triangle_count_dense` — adjacency matmul on the MXU:
+  count = Σ (A@A) ⊙ A / 6 for a simple undirected graph. The window's
+  interned vertex set is usually small; a V×V bfloat16/f32 matmul is
+  one systolic-array pass. Used when V ≤ `DENSE_LIMIT`.
+
+- `triangle_count_sparse` — edge-iterator adjacency intersection:
+  edges are deduplicated and oriented low→high by (degree, id) so
+  per-source out-degree is O(√E); for each oriented edge (a,b) the
+  sorted out-neighbor rows of a and b are intersected with a vmapped
+  binary search. Each triangle is counted exactly once, at its
+  min-rank edge. All-int32, O(E·d_out·log d_out) parallel work.
+
+Both consume a COO batch of dense vertex ids (pre-interned).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import segment as seg_ops
+
+DENSE_LIMIT = 2048
+
+
+# ----------------------------------------------------------------------
+# dense (MXU) path
+# ----------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("num_vertices",))
+def _dense_row_counts(src: jax.Array, dst: jax.Array,
+                      num_vertices: int) -> jax.Array:
+    """src/dst: directed COO with padding at index num_vertices (dropped).
+
+    Returns per-row Σ_j (A²⊙A)[i,j] — each ≤ V² < 2²⁴, so exact in f32;
+    the global sum is finished in int64 on the host to stay exact for
+    windows where 6·T would overflow f32/int32.
+    """
+    v = num_vertices
+    a = jnp.zeros((v + 1, v + 1), jnp.float32)
+    # symmetrize + drop duplicates/self-loops via set-to-one scatter
+    a = a.at[src, dst].set(1.0).at[dst, src].set(1.0)
+    a = a.at[jnp.arange(v + 1), jnp.arange(v + 1)].set(0.0)
+    a = a[:v, :v]
+    paths2 = a @ a  # MXU: paths of length 2
+    return jnp.sum(paths2 * a, axis=1)
+
+
+def triangle_count_dense(src: np.ndarray, dst: np.ndarray,
+                         num_vertices: int) -> int:
+    vb = seg_ops.bucket_size(num_vertices)
+    eb = seg_ops.bucket_size(len(src))
+    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)
+    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)
+    rows = np.asarray(_dense_row_counts(jnp.asarray(s), jnp.asarray(d), vb))
+    return int(rows.astype(np.int64).sum() // 6)
+
+
+# ----------------------------------------------------------------------
+# sparse (wedge + binary search) path
+# ----------------------------------------------------------------------
+
+@jax.jit
+def _intersect_count(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
+                     emask: jax.Array) -> jax.Array:
+    """For each oriented edge (a,b), |N_out(a) ∩ N_out(b)| summed.
+
+    nbr:   [V+1, K] per-vertex sorted out-neighbor rows, fill = V
+           (sorts last, never a real vertex; row V is the pad row).
+    ea/eb: [Ep] oriented edge endpoints (padding → V, masked by emask).
+
+    A triangle {x,y,z} ordered by rank contributes exactly one common
+    out-neighbor (z) at exactly one edge (x,y).
+    """
+    sentinel = nbr.shape[0] - 1
+    rows_a = nbr[ea]                             # [Ep, K]
+    rows_b = nbr[eb]                             # [Ep, K]
+    pos = jax.vmap(jnp.searchsorted)(rows_b, rows_a)
+    pos = jnp.clip(pos, 0, rows_b.shape[1] - 1)
+    found = jnp.take_along_axis(rows_b, pos, axis=1) == rows_a
+    valid = (rows_a < sentinel) & emask[:, None]
+    return jnp.sum(found & valid, dtype=jnp.int32)
+
+
+def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,
+                          num_vertices: int) -> int:
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    if len(src) == 0:
+        return 0
+    # undirect + dedupe
+    lo = np.minimum(src, dst)
+    hi = np.maximum(src, dst)
+    und = np.unique(lo * num_vertices + hi)
+    lo, hi = und // num_vertices, und % num_vertices
+    # orient low-rank → high-rank by (degree, id): bounds out-degree to
+    # O(√E) on skewed graphs, the classic edge-iterator trick
+    deg = np.bincount(np.concatenate([lo, hi]), minlength=num_vertices)
+    rank = np.argsort(np.argsort(deg.astype(np.int64) * num_vertices
+                                 + np.arange(num_vertices)))
+    a = np.where(rank[lo] < rank[hi], lo, hi).astype(np.int32)
+    b = np.where(rank[lo] < rank[hi], hi, lo).astype(np.int32)
+    e = len(a)
+    order = np.argsort(a.astype(np.int64) * num_vertices + b, kind="stable")
+    a, b = a[order], b[order]
+    counts = np.bincount(a, minlength=num_vertices)
+    starts = np.zeros(num_vertices + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    max_out = seg_ops.bucket_size(int(counts.max()))
+    # bucket the vertex dimension too, or every distinct per-window
+    # vertex count triggers a fresh XLA compile; rows past num_vertices
+    # (including sentinel row vb) stay all-sentinel
+    vb = seg_ops.bucket_size(num_vertices)
+    nbr = np.full((vb + 1, max_out), vb, np.int32)
+    nbr[a, np.arange(e) - starts[a]] = b  # ascending within each row
+    ep = seg_ops.bucket_size(e)
+    count = _intersect_count(
+        jnp.asarray(nbr),
+        jnp.asarray(seg_ops.pad_to(a, ep, fill=vb)),
+        jnp.asarray(seg_ops.pad_to(b, ep, fill=vb)),
+        jnp.asarray(seg_ops.pad_to(np.ones(e, bool), ep, fill=False)),
+    )
+    return int(count)
+
+
+def triangle_count(src: np.ndarray, dst: np.ndarray, num_vertices: int) -> int:
+    """Pick the MXU dense path for small windows, wedge path otherwise."""
+    if num_vertices <= DENSE_LIMIT:
+        return triangle_count_dense(src, dst, num_vertices)
+    return triangle_count_sparse(src, dst, num_vertices)
