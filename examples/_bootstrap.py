@@ -1,0 +1,12 @@
+"""Shared CLI bootstrap: puts the repo on sys.path and handles the
+--cpu flag (hermetic CPU backend instead of the real TPU chip)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu" in sys.argv:
+    sys.argv.remove("--cpu")
+    from gelly_streaming_tpu.core.platform import use_cpu
+    use_cpu()

@@ -12,10 +12,7 @@ import sys
 
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
-if "--cpu" in sys.argv:
-    sys.argv.remove("--cpu")
-    from gelly_streaming_tpu.core.platform import use_cpu
-    use_cpu()
+import _bootstrap  # noqa: F401  (repo path + --cpu flag handling)
 
 from gelly_streaming_tpu import Edge, NULL, StreamEnvironment
 from gelly_streaming_tpu.models.sampling_triangles import \

@@ -55,6 +55,18 @@ class DataStream:
         (reference: BroadcastTriangleCount.java:42)."""
         return DataStream(self.env, OpNode("broadcast", [self.node]))
 
+    def parallel_flat_map(self, fn_factory: Callable[[], Any],
+                          parallelism: int) -> "DataStream":
+        """`parallelism` independent stateful flat-map instances, each
+        seeing this (typically broadcast) stream in full — the
+        broadcast + parallel RichFlatMapFunction pattern
+        (reference: BroadcastTriangleCount.java:42-45)."""
+        return DataStream(
+            self.env,
+            OpNode("parallel_flat_map", [self.node],
+                   parallelism=parallelism, fn_factory=fn_factory),
+        )
+
     def set_parallelism(self, parallelism: int) -> "DataStream":
         self.node.parallelism = parallelism
         return self

@@ -25,6 +25,7 @@ import numpy as np
 from ..core.datastream import DataStream
 from ..ops import segment as seg_ops
 from ..ops import unionfind
+from ..utils.interning import IncrementalInterner
 
 
 class AssignComponents:
@@ -98,25 +99,38 @@ def iterative_connected_components(edges: DataStream,
 
 
 class TpuIterativeConnectedComponents:
-    """In-step while_loop label propagation with carried labels."""
+    """In-step while_loop label propagation over carried device state.
+
+    Vertex ids get stable dense slots (IncrementalInterner); the label
+    vector is device-resident and grows by bucket doubling, so a batch
+    costs O(E_batch + V_bucket) vectorized work, not a rebuild of the
+    whole history.
+    """
 
     def __init__(self):
-        self._labels: Dict[int, int] = {}
+        self._interner = IncrementalInterner()
+        self._labels = np.arange(0, dtype=np.int32)  # dense slot -> dense root
 
     def process_batch(self, src: np.ndarray, dst: np.ndarray):
         """Union a batch of edges into the carried labeling; returns the
-        (vertex, label) pairs that changed."""
-        # fold carried labels in as extra (vertex → label) edges so
-        # cross-batch merges happen inside the same device program
-        carried = np.array(list(self._labels.items()), dtype=np.int64)
-        all_src = np.concatenate([src, carried[:, 0]]) if len(carried) else src
-        all_dst = np.concatenate([dst, carried[:, 1]]) if len(carried) else dst
-        uniq, (s, d) = seg_ops.intern(all_src, all_dst)
-        labels = unionfind.connected_components(s, d, len(uniq))
-        roots = uniq[labels]
-        changed = []
-        for v, root in zip(uniq.tolist(), roots.tolist()):
-            if self._labels.get(v) != root:
-                self._labels[v] = root
-                changed.append((v, root))
-        return changed
+        (vertex, component) pairs whose component changed, component =
+        the smallest-slot vertex's id (first-seen vertex of the
+        component, matching min-label semantics in arrival order)."""
+        s = self._interner.intern_array(np.asarray(src))
+        d = self._interner.intern_array(np.asarray(dst))
+        v = len(self._interner)
+        vb = seg_ops.bucket_size(v)
+        old = self._labels
+        labels = np.arange(vb, dtype=np.int32)
+        labels[: len(old)] = old
+        new = unionfind.connected_components_with_labels(s, d, labels, vb)
+        changed_slots = np.nonzero(new[:v] != labels[:v])[0]
+        # also report fresh vertices (slots beyond the previous state)
+        fresh = np.arange(len(old), v)[new[len(old):v]
+                                       == np.arange(len(old), v)]
+        self._labels = new[:v]
+        out = []
+        for slot in np.concatenate([changed_slots, fresh]).tolist():
+            out.append((self._interner.id_of(slot),
+                        self._interner.id_of(int(new[slot]))))
+        return out

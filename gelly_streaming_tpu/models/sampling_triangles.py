@@ -136,14 +136,13 @@ def broadcast_triangle_count(edges: DataStream, samples: int,
     stream to `parallelism` sampler instances, funnel estimates through
     one summer."""
     local = max(1, samples // parallelism)
-    sampled = DataStream(
-        edges.env,
-        OpNode("parallel_flat_map", [edges.broadcast().node],
-               parallelism=parallelism,
-               fn_factory=lambda: VectorTriangleSampler(local, vertex_count)),
+    sampled = edges.broadcast().parallel_flat_map(
+        lambda: VectorTriangleSampler(local, vertex_count), parallelism
     )
+    # normalize by the number of instances actually running (rounding /
+    # the >=1-per-subtask floor can make local*parallelism != samples)
     return sampled.flat_map(
-        TriangleSummer(samples, vertex_count)
+        TriangleSummer(local * parallelism, vertex_count)
     ).set_parallelism(1)
 
 
@@ -220,7 +219,9 @@ class RoutedTriangleSampler:
             self.src[k, i] = edge.source
             self.trg[k, i] = edge.target
             third = int(self.rng.integers(0, self.v))
-            while third in (edge.source, edge.target):
+            for _ in range(64):  # bounded redraw (degenerate v <= 2)
+                if third not in (edge.source, edge.target):
+                    break
                 third = int(self.rng.integers(0, self.v))
             self.third[k, i] = third
             self.src_found[k, i] = False
@@ -259,5 +260,5 @@ def incidence_sampling_triangle_count(edges: DataStream, samples: int,
         RoutedTriangleSampler(local, vertex_count, parallelism)
     )
     return estimates.flat_map(
-        TriangleSummer(samples, vertex_count)
+        TriangleSummer(local * parallelism, vertex_count)
     ).set_parallelism(1)

@@ -24,7 +24,6 @@ import numpy as np
 from ..core.datastream import DataStream
 from ..core.gtime import Time
 from ..core.plan import OpNode
-from ..core.types import EdgeDirection
 from ..ops import segment as seg_ops
 from ..ops import triangles as tri_ops
 

@@ -74,17 +74,18 @@ def triangle_count_dense(src: np.ndarray, dst: np.ndarray,
 # sparse (wedge + binary search) path
 # ----------------------------------------------------------------------
 
-@jax.jit
-def _intersect_count(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
-                     emask: jax.Array) -> jax.Array:
-    """For each oriented edge (a,b), |N_out(a) ∩ N_out(b)| summed.
+def intersect_local(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
+                    emask: jax.Array) -> jax.Array:
+    """For each oriented edge (a,b), |N_out(a) ∩ N_out(b)| summed over
+    the given (possibly per-shard) edge slice.
 
     nbr:   [V+1, K] per-vertex sorted out-neighbor rows, fill = V
            (sorts last, never a real vertex; row V is the pad row).
     ea/eb: [Ep] oriented edge endpoints (padding → V, masked by emask).
 
     A triangle {x,y,z} ordered by rank contributes exactly one common
-    out-neighbor (z) at exactly one edge (x,y).
+    out-neighbor (z) at exactly one edge (x,y). Shared by the
+    single-chip kernel and the sharded engine (which psums the slices).
     """
     sentinel = nbr.shape[0] - 1
     rows_a = nbr[ea]                             # [Ep, K]
@@ -94,6 +95,9 @@ def _intersect_count(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
     found = jnp.take_along_axis(rows_b, pos, axis=1) == rows_a
     valid = (rows_a < sentinel) & emask[:, None]
     return jnp.sum(found & valid, dtype=jnp.int32)
+
+
+_intersect_count = jax.jit(intersect_local)
 
 
 def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,

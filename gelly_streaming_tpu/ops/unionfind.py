@@ -28,6 +28,37 @@ import numpy as np
 from . import segment as seg_ops
 
 
+def cc_round(labels: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
+    """One local min-label sweep: scatter-min each edge's smaller label to
+    both endpoints. Shared by the single-chip loop, the sharded loop
+    (which adds a pmin exchange per round), and the fused entry step."""
+    m = jnp.minimum(labels[src], labels[dst])
+    return labels.at[src].min(m).at[dst].min(m)
+
+
+def cc_fixpoint(labels0: jax.Array, src: jax.Array, dst: jax.Array,
+                exchange=None) -> jax.Array:
+    """Run cc_round + pointer jumping to the fixpoint inside a
+    while_loop; `exchange` (e.g. a pmin over the mesh axis) merges
+    labels across shards each round."""
+
+    def cond(state):
+        _, changed = state
+        return changed
+
+    def body(state):
+        labels, _ = state
+        new = cc_round(labels, src, dst)
+        if exchange is not None:
+            new = exchange(new)
+        # pointer jumping: jump each label to its label's label
+        new = new[new]
+        return new, jnp.any(new != labels)
+
+    labels, _ = jax.lax.while_loop(cond, body, (labels0, jnp.array(True)))
+    return labels
+
+
 @functools.partial(jax.jit, static_argnames=("num_vertices",))
 def cc_labels(src: jax.Array, dst: jax.Array, num_vertices: int) -> jax.Array:
     """Labels[v] = smallest vertex index in v's component.
@@ -36,21 +67,7 @@ def cc_labels(src: jax.Array, dst: jax.Array, num_vertices: int) -> jax.Array:
     Returns int32 [num_vertices + 1] (last row is the padding sentinel).
     """
     labels0 = jnp.arange(num_vertices + 1, dtype=jnp.int32)
-
-    def cond(state):
-        _, changed = state
-        return changed
-
-    def body(state):
-        labels, _ = state
-        m = jnp.minimum(labels[src], labels[dst])
-        new = labels.at[src].min(m).at[dst].min(m)
-        # pointer jumping: jump each label to its label's label
-        new = new[new]
-        return new, jnp.any(new != labels)
-
-    labels, _ = jax.lax.while_loop(cond, body, (labels0, jnp.array(True)))
-    return labels
+    return cc_fixpoint(labels0, src, dst)
 
 
 def connected_components(src: np.ndarray, dst: np.ndarray,
@@ -64,6 +81,27 @@ def connected_components(src: np.ndarray, dst: np.ndarray,
     labels = np.asarray(cc_labels(jnp.asarray(s), jnp.asarray(d), vb))
     # bucket-padding vertices are isolated; compress to true vertex range
     return labels[:num_vertices]
+
+
+_cc_fixpoint_jit = jax.jit(cc_fixpoint)
+
+
+def connected_components_with_labels(src: np.ndarray, dst: np.ndarray,
+                                     labels: np.ndarray,
+                                     num_vertices: int) -> np.ndarray:
+    """Carried-state variant: fold a batch of edges into an existing
+    labeling (streaming-iteration semantics, strategy P5). `labels` is a
+    dense int32 [num_vertices] forest pointing at equal-or-smaller
+    slots; returns the converged labels of the same length."""
+    e = len(src)
+    eb = seg_ops.bucket_size(e)
+    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=num_vertices)
+    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=num_vertices)
+    lab = np.concatenate([np.asarray(labels, np.int32),
+                          np.array([num_vertices], np.int32)])
+    out = np.asarray(_cc_fixpoint_jit(jnp.asarray(lab), jnp.asarray(s),
+                                      jnp.asarray(d)))
+    return out[:num_vertices]
 
 
 def bipartite_labels(src: np.ndarray, dst: np.ndarray, num_vertices: int):

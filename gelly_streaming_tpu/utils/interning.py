@@ -1,0 +1,43 @@
+"""Incremental vertex-id interning for streaming state.
+
+The host half of SURVEY.md §7's "vertex-id interning at stream rate":
+arbitrary hashable vertex ids get stable dense int32 slots, assigned
+once on first sight, so device-resident per-vertex state (degree
+vectors, CC labels) can live in fixed arrays that grow by bucket
+doubling instead of being rebuilt per batch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Hashable, List
+
+import numpy as np
+
+
+class IncrementalInterner:
+    def __init__(self):
+        self._to_dense: Dict[Hashable, int] = {}
+        self._to_id: List[Hashable] = []
+
+    def __len__(self) -> int:
+        return len(self._to_id)
+
+    def intern_array(self, ids: np.ndarray) -> np.ndarray:
+        """Map ids to dense slots, assigning new slots on first sight."""
+        out = np.empty(len(ids), np.int32)
+        to_dense = self._to_dense
+        to_id = self._to_id
+        for i, v in enumerate(ids.tolist()):
+            slot = to_dense.get(v)
+            if slot is None:
+                slot = len(to_id)
+                to_dense[v] = slot
+                to_id.append(v)
+            out[i] = slot
+        return out
+
+    def id_of(self, dense: int) -> Hashable:
+        return self._to_id[dense]
+
+    def ids_of(self, dense: np.ndarray) -> List[Hashable]:
+        return [self._to_id[i] for i in dense.tolist()]

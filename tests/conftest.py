@@ -7,34 +7,11 @@ multi-subtask single-JVM StreamingProgramTestBase — with golden-output
 comparison of sorted result lines.
 """
 
-import os
+# Must run before jax initializes a backend: tests are hermetic CPU runs
+# on a virtual 8-device mesh, never the real (single, shared) TPU chip.
+from gelly_streaming_tpu.core.platform import cpu_mesh
 
-# Must run before jax initializes a backend. Force (not setdefault): the
-# surrounding environment pins JAX_PLATFORMS to the real TPU tunnel, and
-# tests must be hermetic CPU runs on the virtual 8-device mesh.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The TPU-tunnel PJRT plugin registers itself in every interpreter via
-# sitecustomize and is initialized even under JAX_PLATFORMS=cpu; drop
-# its factory so tests never dial the (single, shareable-with-bench)
-# real chip.
-try:
-    import jax as _jax
-
-    # sitecustomize imports jax before this file runs, so the config has
-    # already captured JAX_PLATFORMS from the environment — update it too.
-    _jax.config.update("jax_platforms", "cpu")
-    from jax._src import xla_bridge as _xb
-
-    for _name in [n for n in _xb._backend_factories if n != "cpu"]:
-        _xb._backend_factories.pop(_name, None)
-except Exception:
-    pass
+cpu_mesh(8)
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,82 @@
+"""Multi-chip kernels on the virtual 8-device CPU mesh — the sharding
+analog of the reference's in-process mini-cluster tests (SURVEY.md §4:
+same dataflow, multiple subtasks, one process).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from gelly_streaming_tpu.parallel.mesh import make_mesh, shard_count
+from gelly_streaming_tpu.parallel.sharded import ShardedWindowEngine
+from gelly_streaming_tpu.ops import segment as seg_ops
+from gelly_streaming_tpu.ops import triangles as tri_ops
+
+
+@pytest.fixture(scope="module")
+def engine():
+    mesh = make_mesh()
+    assert shard_count(mesh) == 8, "conftest should provide 8 CPU devices"
+    return ShardedWindowEngine(mesh, num_vertices_bucket=64)
+
+
+def test_sharded_degrees_match_host(engine):
+    rng = np.random.default_rng(0)
+    src = rng.integers(0, 50, 333)
+    dst = rng.integers(0, 50, 333)
+    out = engine.degrees(src, dst)
+    expected = (np.bincount(src, minlength=64)
+                + np.bincount(dst, minlength=64))
+    np.testing.assert_array_equal(out, expected)
+    # second window accumulates (continuous-degree semantics)
+    out2 = engine.degrees(src, dst)
+    np.testing.assert_array_equal(out2, 2 * expected)
+
+
+def test_sharded_cc_labels(engine):
+    # two components: 0-1-2-3 chain, 10-11
+    src = np.array([0, 1, 2, 10])
+    dst = np.array([1, 2, 3, 11])
+    labels = engine.cc_labels(src, dst, carry=False)
+    assert labels[0] == labels[1] == labels[2] == labels[3] == 0
+    assert labels[10] == labels[11] == 10
+    # carried state: bridging edge merges components (P5 iteration)
+    labels = engine.cc_labels(np.array([3]), np.array([10]), carry=True)
+    assert labels[11] == 0
+
+
+def test_sharded_triangles_match_single_chip(engine):
+    rng = np.random.default_rng(3)
+    n, e = 40, 300
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    expected = tri_ops.triangle_count_sparse(src, dst, n)
+
+    # build the oriented CSR exactly as the single-chip path does
+    lo = np.minimum(src, dst)
+    hi = np.maximum(src, dst)
+    keep = lo != hi
+    lo, hi = lo[keep], hi[keep]
+    und = np.unique(lo * n + hi)
+    lo, hi = und // n, und % n
+    deg = np.bincount(np.concatenate([lo, hi]), minlength=n)
+    rank = np.argsort(np.argsort(deg.astype(np.int64) * n + np.arange(n)))
+    a = np.where(rank[lo] < rank[hi], lo, hi).astype(np.int32)
+    b = np.where(rank[lo] < rank[hi], hi, lo).astype(np.int32)
+    order = np.argsort(a.astype(np.int64) * n + b, kind="stable")
+    a, b = a[order], b[order]
+    counts = np.bincount(a, minlength=n)
+    starts = np.zeros(n + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    vb = seg_ops.bucket_size(n)
+    max_out = seg_ops.bucket_size(int(counts.max()))
+    nbr = np.full((vb + 1, max_out), vb, np.int32)
+    nbr[a, np.arange(len(a)) - starts[a]] = b
+
+    got = engine.triangles(nbr, a, b, np.ones(len(a), bool))
+    assert got == expected
+
+
+def test_mesh_uses_all_devices():
+    assert len(jax.devices()) == 8
