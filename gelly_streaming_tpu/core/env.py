@@ -83,6 +83,20 @@ class StreamEnvironment:
         """Records collected by a `.collect()` sink (values only)."""
         return [v for (v, _ts) in self._results.get(stream.node.id, [])]
 
+    # ------------------------------------------------------------------
+    # tracing / metrics (utils/tracing.py; absent in the reference,
+    # SURVEY.md §5.1/§5.5)
+    # ------------------------------------------------------------------
+    def enable_tracing(self) -> "StreamEnvironment":
+        from ..utils.tracing import StepTimer
+
+        self.timer = StepTimer()
+        return self
+
+    def trace_report(self) -> List[dict]:
+        timer = getattr(self, "timer", None)
+        return timer.report() if timer else []
+
 
 class JobExecutionResult:
     """Mirror of the reference's use of `JobExecutionResult.getNetRuntime()`

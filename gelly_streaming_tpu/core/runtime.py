@@ -55,6 +55,7 @@ class Executor:
     def __init__(self, env):
         self.env = env
         self.memo: Dict[int, List[Record]] = {}
+        self._timing_stack: List[float] = []
 
     # ------------------------------------------------------------------
     def run(self) -> Dict[int, List[Record]]:
@@ -126,7 +127,23 @@ class Executor:
         if node.kind == "iterate" and overrides is None:
             self._run_iteration(node)
             return self.memo[node.id]
-        records = self._apply(node, overrides, cache)
+        timer = getattr(self.env, "timer", None)
+        if timer is None:
+            records = self._apply(node, overrides, cache)
+        else:
+            # exclusive per-operator timing: subtract time spent
+            # evaluating parents inside _apply
+            import time as _t
+
+            t0 = _t.perf_counter()
+            self._timing_stack.append(0.0)
+            records = self._apply(node, overrides, cache)
+            elapsed = _t.perf_counter() - t0
+            child_time = self._timing_stack.pop()
+            if self._timing_stack:
+                self._timing_stack[-1] += elapsed
+            timer.add(f"{node.kind}#{node.id}", elapsed - child_time,
+                      len(records))
         memo[node.id] = records
         return records
 

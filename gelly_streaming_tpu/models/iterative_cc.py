@@ -25,7 +25,7 @@ import numpy as np
 from ..core.datastream import DataStream
 from ..ops import segment as seg_ops
 from ..ops import unionfind
-from ..utils.interning import IncrementalInterner
+from ..utils.interning import make_interner
 
 
 class AssignComponents:
@@ -108,7 +108,7 @@ class TpuIterativeConnectedComponents:
     """
 
     def __init__(self):
-        self._interner = IncrementalInterner()
+        self._interner = None  # chosen (native vs python) on first batch
         self._labels = np.arange(0, dtype=np.int32)  # dense slot -> dense root
 
     def process_batch(self, src: np.ndarray, dst: np.ndarray):
@@ -116,6 +116,8 @@ class TpuIterativeConnectedComponents:
         (vertex, component) pairs whose component changed, component =
         the smallest-slot vertex's id (first-seen vertex of the
         component, matching min-label semantics in arrival order)."""
+        if self._interner is None:
+            self._interner = make_interner(np.asarray(src))
         s = self._interner.intern_array(np.asarray(src))
         d = self._interner.intern_array(np.asarray(dst))
         v = len(self._interner)
@@ -134,3 +136,22 @@ class TpuIterativeConnectedComponents:
             out.append((self._interner.id_of(slot),
                         self._interner.id_of(int(new[slot]))))
         return out
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (utils/checkpoint.py)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        ids = (list(self._interner.ids_of(
+                   np.arange(len(self._interner), dtype=np.int32)))
+               if self._interner is not None else [])
+        if all(isinstance(i, (int, np.integer)) for i in ids):
+            ids = np.asarray(ids, np.int64)  # compact array form
+        return {"labels": self._labels, "ids": ids}
+
+    def load_state_dict(self, state: dict) -> None:
+        ids = state["ids"]
+        ids = np.asarray(ids) if len(ids) else np.asarray([], np.int64)
+        self._interner = make_interner(ids)
+        if len(ids):
+            self._interner.intern_array(ids)
+        self._labels = np.asarray(state["labels"], np.int32)

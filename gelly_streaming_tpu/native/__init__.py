@@ -1,0 +1,158 @@
+"""ctypes bindings for the native host-runtime kernels (ingest.cpp).
+
+Loads (building on first use if the toolchain is available)
+libgsnative.so; every entry point has a numpy/python fallback so the
+framework works without a compiler. `available()` reports which path is
+active.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_DIR, "libgsnative.so")
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    lib.gs_parse_edges.restype = ctypes.c_int64
+    lib.gs_parse_edges.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.gs_assign_windows.restype = None
+    lib.gs_assign_windows.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    lib.gs_interner_new.restype = ctypes.c_void_p
+    lib.gs_interner_free.argtypes = [ctypes.c_void_p]
+    lib.gs_interner_size.restype = ctypes.c_int64
+    lib.gs_interner_size.argtypes = [ctypes.c_void_p]
+    lib.gs_interner_intern.restype = None
+    lib.gs_interner_intern.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    lib.gs_interner_lookup.restype = None
+    lib.gs_interner_lookup.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _i64ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
+
+
+def _i32ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+# ----------------------------------------------------------------------
+def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Parse 'src dst [ts]' lines into int64 COO arrays (ts = -1 when
+    missing). Native fast path; numpy loadtxt-style fallback."""
+    with open(path, "rb") as f:
+        data = f.read()
+    lib = _load()
+    if lib is not None:
+        max_edges = data.count(b"\n") + 1
+        src = np.empty(max_edges, np.int64)
+        dst = np.empty(max_edges, np.int64)
+        ts = np.empty(max_edges, np.int64)
+        n = lib.gs_parse_edges(data, len(data), max_edges,
+                               _i64ptr(src), _i64ptr(dst), _i64ptr(ts))
+        return src[:n].copy(), dst[:n].copy(), ts[:n].copy()
+    src_l, dst_l, ts_l = [], [], []
+    for line in data.decode().splitlines():
+        fields = line.split()
+        if len(fields) >= 2:
+            try:  # parse the whole line before appending anything, so a
+                # malformed field can't leave the arrays misaligned
+                row = (int(fields[0]), int(fields[1]),
+                       int(fields[2]) if len(fields) > 2 else -1)
+            except ValueError:
+                continue
+            src_l.append(row[0])
+            dst_l.append(row[1])
+            ts_l.append(row[2])
+    return (np.array(src_l, np.int64), np.array(dst_l, np.int64),
+            np.array(ts_l, np.int64))
+
+
+def assign_windows(ts: np.ndarray, size_ms: int) -> np.ndarray:
+    """Tumbling window starts per timestamp (Flink TimeWindow floor)."""
+    ts = np.ascontiguousarray(ts, np.int64)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(len(ts), np.int64)
+        lib.gs_assign_windows(_i64ptr(ts), len(ts), size_ms, _i64ptr(out))
+        return out
+    return ts - np.mod(ts, size_ms)
+
+
+class NativeInterner:
+    """Incremental int64-id interner backed by the C++ hash map, with
+    the same contract as utils.interning.IncrementalInterner."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._handle = lib.gs_interner_new()
+
+    def __len__(self) -> int:
+        return int(self._lib.gs_interner_size(self._handle))
+
+    def intern_array(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64)
+        out = np.empty(len(ids), np.int32)
+        self._lib.gs_interner_intern(self._handle, _i64ptr(ids), len(ids),
+                                     _i32ptr(out))
+        return out
+
+    def ids_of(self, dense: np.ndarray) -> np.ndarray:
+        dense = np.ascontiguousarray(dense, np.int32)
+        out = np.empty(len(dense), np.int64)
+        self._lib.gs_interner_lookup(self._handle, _i32ptr(dense),
+                                     len(dense), _i64ptr(out))
+        return out
+
+    def id_of(self, dense: int) -> int:
+        return int(self.ids_of(np.array([dense], np.int32))[0])
+
+    def __del__(self):
+        try:
+            self._lib.gs_interner_free(self._handle)
+        except Exception:
+            pass

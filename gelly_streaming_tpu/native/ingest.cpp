@@ -1,0 +1,121 @@
+// Native host-runtime kernels: edge-file parsing, tumbling-window
+// assignment, and incremental vertex interning.
+//
+// The reference delegates its host runtime to Flink's JVM (SURVEY.md §1
+// L1); our host driver's hot loops — the parts that feed the TPU —
+// are implemented here and exposed over a C ABI consumed via ctypes
+// (gelly_streaming_tpu/native/__init__.py). Python fallbacks exist for
+// every entry point.
+//
+// Build: make -C gelly_streaming_tpu/native   (produces libgsnative.so)
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Edge text parsing: whitespace-separated "src dst [ts]" lines.
+// Returns the number of edges parsed; fills src/dst/ts (ts = -1 when a
+// line has only two fields). Stops at max_edges.
+// ---------------------------------------------------------------------
+int64_t gs_parse_edges(const char* buf, int64_t len, int64_t max_edges,
+                       int64_t* src, int64_t* dst, int64_t* ts) {
+    int64_t count = 0;
+    const char* p = buf;
+    const char* end = buf + len;
+    while (p < end && count < max_edges) {
+        // skip blank space / newlines
+        while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+            ++p;
+        if (p >= end) break;
+        int64_t fields[3] = {0, 0, -1};
+        int nfields = 0;
+        while (p < end && *p != '\n') {
+            while (p < end && (*p == ' ' || *p == '\t')) ++p;
+            if (p >= end || *p == '\n') break;
+            bool neg = false;
+            if (*p == '-') { neg = true; ++p; }
+            int64_t v = 0;
+            bool digits = false;
+            while (p < end && *p >= '0' && *p <= '9') {
+                v = v * 10 + (*p - '0');
+                ++p;
+                digits = true;
+            }
+            if (!digits) {  // malformed token: skip to end of line
+                while (p < end && *p != '\n') ++p;
+                nfields = -1;
+                break;
+            }
+            if (nfields < 3) fields[nfields] = neg ? -v : v;
+            ++nfields;
+        }
+        if (nfields >= 2) {
+            src[count] = fields[0];
+            dst[count] = fields[1];
+            ts[count] = fields[2];
+            ++count;
+        }
+    }
+    return count;
+}
+
+// ---------------------------------------------------------------------
+// Tumbling-window assignment: wstart[i] = ts[i] - ts[i] % size
+// (Flink TimeWindow semantics; SimpleEdgeStream.java:159-167).
+// ---------------------------------------------------------------------
+void gs_assign_windows(const int64_t* ts, int64_t n, int64_t size,
+                       int64_t* wstart) {
+    for (int64_t i = 0; i < n; ++i) {
+        int64_t t = ts[i];
+        int64_t w = t % size;
+        if (w < 0) w += size;  // floor semantics for negative timestamps
+        wstart[i] = t - w;
+    }
+}
+
+// ---------------------------------------------------------------------
+// Incremental interner: stable dense slots for int64 vertex ids
+// (SURVEY.md §7 "vertex-id interning at stream rate").
+// ---------------------------------------------------------------------
+struct GsInterner {
+    std::unordered_map<int64_t, int32_t> to_dense;
+    std::vector<int64_t> to_id;
+};
+
+void* gs_interner_new() { return new GsInterner(); }
+
+void gs_interner_free(void* h) { delete static_cast<GsInterner*>(h); }
+
+int64_t gs_interner_size(void* h) {
+    return static_cast<int64_t>(static_cast<GsInterner*>(h)->to_id.size());
+}
+
+void gs_interner_intern(void* h, const int64_t* ids, int64_t n,
+                        int32_t* out) {
+    auto* interner = static_cast<GsInterner*>(h);
+    for (int64_t i = 0; i < n; ++i) {
+        auto it = interner->to_dense.find(ids[i]);
+        if (it == interner->to_dense.end()) {
+            int32_t slot = static_cast<int32_t>(interner->to_id.size());
+            interner->to_dense.emplace(ids[i], slot);
+            interner->to_id.push_back(ids[i]);
+            out[i] = slot;
+        } else {
+            out[i] = it->second;
+        }
+    }
+}
+
+// dense slot -> original id (bulk)
+void gs_interner_lookup(void* h, const int32_t* dense, int64_t n,
+                        int64_t* out) {
+    auto* interner = static_cast<GsInterner*>(h);
+    for (int64_t i = 0; i < n; ++i) out[i] = interner->to_id[dense[i]];
+}
+
+}  // extern "C"

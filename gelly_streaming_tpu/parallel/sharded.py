@@ -156,6 +156,21 @@ class ShardedWindowEngine:
         self._labels = self.cc_fn(s, d, labels)
         return np.asarray(self._labels[: self.vb])
 
+    # ------------------------------------------------------------------
+    # checkpoint / resume (utils/checkpoint.py)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "vb": self.vb,
+            "degree_state": np.asarray(self._degree_state),
+            "labels": np.asarray(self._labels),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        assert state["vb"] == self.vb, "vertex bucket mismatch"
+        self._degree_state = jnp.asarray(state["degree_state"])
+        self._labels = jnp.asarray(state["labels"])
+
     def triangles(self, nbr, ea, eb, emask) -> int:
         target = mesh_padded_len(len(ea), self.mesh)
         sentinel = nbr.shape[0] - 1

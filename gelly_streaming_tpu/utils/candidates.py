@@ -131,6 +131,28 @@ class Candidates:
         return True
 
     # ------------------------------------------------------------------
+    # checkpoint / resume (utils/checkpoint.py)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "success": self.success,
+            "components": {
+                str(k): {str(v): sv.sign for v, sv in comp.items()}
+                for k, comp in self.map.items()
+            },
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self.success = state["success"]
+        self.map = {
+            int(k): {
+                int(v): SignedVertex(int(v), bool(sign))
+                for v, sign in comp.items()
+            }
+            for k, comp in state["components"].items()
+        }
+
+    # ------------------------------------------------------------------
     def __repr__(self) -> str:
         inner = ", ".join(
             "{}={{{}}}".format(

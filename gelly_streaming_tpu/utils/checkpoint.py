@@ -1,0 +1,94 @@
+"""Checkpoint / resume.
+
+Absent in the reference (SURVEY.md §5.4): its operator state (per-key
+hash maps, pane accumulators, merger state) is implicit in the JVM.
+Here every stateful engine exposes `state_dict()` / `load_state_dict()`
+over plain pytrees (nested dicts of numpy arrays / scalars / lists), so
+a streaming job can snapshot between windows and resume after failure —
+the recovery story the reference's combine-fn javadoc alludes to
+(library/ConnectedComponents.java:117-118) but never implements.
+
+Storage: a single .npz for array leaves + a JSON sidecar-free encoding
+of the tree structure (object leaves go through repr-safe lists).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+import numpy as np
+
+_ARRAY_KEY = "__arrays__"
+
+
+def _key(k):
+    """Encode a dict key preserving its type across the JSON spec."""
+    if isinstance(k, bool) or not isinstance(k, (int, str)):
+        raise TypeError(f"unsupported checkpoint dict key: {k!r}")
+    return ["i", k] if isinstance(k, int) else ["s", k]
+
+
+def _unkey(pair):
+    kind, k = pair
+    return int(k) if kind == "i" else k
+
+
+def _flatten(tree: Any, prefix: str, arrays: Dict[str, np.ndarray]):
+    if isinstance(tree, dict):
+        return {
+            "t": "dict",
+            "items": [
+                [_key(k), _flatten(v, f"{prefix}.{k}", arrays)]
+                for k, v in tree.items()
+            ],
+        }
+    if isinstance(tree, np.ndarray):
+        arrays[prefix] = tree
+        return {"t": "array", "key": prefix}
+    if isinstance(tree, (list, tuple)):
+        return {
+            "t": "list" if isinstance(tree, list) else "tuple",
+            "items": [
+                _flatten(v, f"{prefix}[{i}]", arrays)
+                for i, v in enumerate(tree)
+            ],
+        }
+    if isinstance(tree, (int, float, str, bool)) or tree is None:
+        return {"t": "scalar", "v": tree}
+    raise TypeError(f"unsupported checkpoint leaf: {type(tree)}")
+
+
+def _unflatten(node: dict, arrays) -> Any:
+    kind = node["t"]
+    if kind == "dict":
+        return {_unkey(k): _unflatten(v, arrays) for k, v in node["items"]}
+    if kind == "array":
+        return arrays[node["key"]]
+    if kind == "list":
+        return [_unflatten(v, arrays) for v in node["items"]]
+    if kind == "tuple":
+        return tuple(_unflatten(v, arrays) for v in node["items"])
+    if kind == "scalar":
+        return node["v"]
+    raise TypeError(kind)
+
+
+def save(path: str, tree: Any) -> None:
+    arrays: Dict[str, np.ndarray] = {}
+    spec = _flatten(tree, "r", arrays)
+    arrays[_ARRAY_KEY + "spec"] = np.frombuffer(
+        json.dumps(spec).encode(), dtype=np.uint8
+    )
+    tmp = path + ".tmp"
+    np.savez_compressed(tmp, **arrays)
+    # np.savez appends .npz to the filename it is given
+    os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
+
+
+def restore(path: str) -> Any:
+    with np.load(path, allow_pickle=False) as data:
+        spec = json.loads(bytes(data[_ARRAY_KEY + "spec"]).decode())
+        arrays = {k: data[k] for k in data.files if k != _ARRAY_KEY + "spec"}
+    return _unflatten(spec, arrays)

@@ -79,6 +79,20 @@ class DisjointSet(Generic[R]):
             comps.setdefault(self.find(v), []).append(v)
         return comps
 
+    # ------------------------------------------------------------------
+    # checkpoint / resume (utils/checkpoint.py)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "elements": list(self._parent.keys()),
+            "parents": list(self._parent.values()),
+            "ranks": [self._rank[e] for e in self._parent],
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        self._parent = dict(zip(state["elements"], state["parents"]))
+        self._rank = dict(zip(state["elements"], state["ranks"]))
+
     def __repr__(self) -> str:
         comps = self.components()
         try:

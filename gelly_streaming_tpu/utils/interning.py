@@ -41,3 +41,19 @@ class IncrementalInterner:
 
     def ids_of(self, dense: np.ndarray) -> List[Hashable]:
         return [self._to_id[i] for i in dense.tolist()]
+
+
+def make_interner(ids_sample: np.ndarray = None):
+    """Pick the native C++ interner for integer id streams, the Python
+    one otherwise (or when the native library can't build)."""
+    if ids_sample is None or np.issubdtype(
+        np.asarray(ids_sample).dtype, np.integer
+    ):
+        try:
+            from .. import native
+
+            if native.available():
+                return native.NativeInterner()
+        except Exception:
+            pass
+    return IncrementalInterner()

@@ -1,0 +1,74 @@
+"""Tracing / profiling.
+
+The reference has none (SURVEY.md §5.1 — only wall-clock via
+getNetRuntime, CentralizedWeightedMatching.java:62-64). Here:
+
+- `StepTimer` — per-operator / per-window wall-time and record counts,
+  collected by the runtime when `env.enable_tracing()` is on.
+- `device_trace` — context manager around `jax.profiler.trace` for a
+  TensorBoard-readable XLA trace of the device kernels.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+from typing import Dict, List
+
+
+class StepTimer:
+    def __init__(self):
+        self.totals: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.records: Dict[str, int] = defaultdict(int)
+
+    def add(self, name: str, seconds: float, num_records: int = 0) -> None:
+        """Record one already-measured step (used by the runtime's
+        exclusive-time accounting)."""
+        self.totals[name] += seconds
+        self.counts[name] += 1
+        self.records[name] += num_records
+
+    @contextlib.contextmanager
+    def step(self, name: str, num_records: int = 0):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0, num_records)
+
+    def report(self) -> List[dict]:
+        out = []
+        for name in sorted(self.totals, key=self.totals.get, reverse=True):
+            total = self.totals[name]
+            recs = self.records[name]
+            out.append({
+                "op": name,
+                "total_s": round(total, 6),
+                "calls": self.counts[name],
+                "records": recs,
+                "records_per_s": round(recs / total) if total and recs else 0,
+            })
+        return out
+
+    def __str__(self) -> str:
+        lines = ["op                            total_s    calls  records  rec/s"]
+        for row in self.report():
+            lines.append(
+                f"{row['op']:<28} {row['total_s']:>9.4f} {row['calls']:>7}"
+                f" {row['records']:>8} {row['records_per_s']:>7}"
+            )
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def device_trace(log_dir: str):
+    """XLA device trace (view in TensorBoard / xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

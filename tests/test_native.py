@@ -1,0 +1,58 @@
+"""Native host-runtime kernels: parser / window assigner / interner,
+cross-checked against the Python fallbacks."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import native
+from gelly_streaming_tpu.utils.interning import IncrementalInterner
+
+
+def test_native_builds():
+    if not native.available():
+        pytest.skip("no C++ toolchain — fallbacks in use")
+
+
+def test_parse_edge_file(tmp_path):
+    p = tmp_path / "edges.txt"
+    p.write_text("1 2 100\n3\t4\t200\n\nbad line\n5 6\n-7 8 300\n")
+    src, dst, ts = native.parse_edge_file(str(p))
+    np.testing.assert_array_equal(src, [1, 3, 5, -7])
+    np.testing.assert_array_equal(dst, [2, 4, 6, 8])
+    np.testing.assert_array_equal(ts, [100, 200, -1, 300])
+
+
+def test_parse_large_roundtrip(tmp_path):
+    rng = np.random.default_rng(0)
+    n = 50_000
+    src = rng.integers(0, 1 << 40, n)
+    dst = rng.integers(0, 1 << 40, n)
+    ts = np.arange(n)
+    p = tmp_path / "big.txt"
+    with open(p, "w") as f:
+        for row in zip(src, dst, ts):
+            f.write("%d %d %d\n" % row)
+    s, d, t = native.parse_edge_file(str(p))
+    np.testing.assert_array_equal(s, src)
+    np.testing.assert_array_equal(d, dst)
+    np.testing.assert_array_equal(t, ts)
+
+
+def test_assign_windows():
+    ts = np.array([0, 99, 100, 250, 999, 1000])
+    np.testing.assert_array_equal(
+        native.assign_windows(ts, 100), [0, 0, 100, 200, 900, 1000]
+    )
+
+
+def test_native_interner_matches_python():
+    if not native.available():
+        pytest.skip("no native lib")
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 500, 5000)
+    nat = native.NativeInterner()
+    py = IncrementalInterner()
+    np.testing.assert_array_equal(nat.intern_array(ids), py.intern_array(ids))
+    assert len(nat) == len(py)
+    dense = np.arange(len(nat), dtype=np.int32)
+    assert list(nat.ids_of(dense)) == py.ids_of(dense)
