@@ -92,6 +92,15 @@ def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         n = lib.gs_parse_edges(data, len(data), max_edges,
                                _i64ptr(src), _i64ptr(dst), _i64ptr(ts))
         return src[:n].copy(), dst[:n].copy(), ts[:n].copy()
+    return _parse_edge_file_py(path)
+
+
+def _parse_edge_file_py(path: str):
+    """Pure-Python parser; must stay behaviorally identical to
+    gs_parse_edges (ingest.cpp) so results never depend on whether the
+    native library is available."""
+    with open(path, "rb") as f:
+        data = f.read()
     src_l, dst_l, ts_l = [], [], []
     for line in data.decode().splitlines():
         fields = line.split()

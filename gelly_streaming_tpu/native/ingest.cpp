@@ -34,11 +34,16 @@ int64_t gs_parse_edges(const char* buf, int64_t len, int64_t max_edges,
         if (p >= end) break;
         int64_t fields[3] = {0, 0, -1};
         int nfields = 0;
-        while (p < end && *p != '\n') {
+        // Only the first three tokens are parsed; anything after them on
+        // the line (labels, extra columns) is ignored — same semantics as
+        // the Python fallback (native/__init__.py), so results cannot
+        // depend on whether the native library is available.
+        while (p < end && *p != '\n' && nfields < 3) {
             while (p < end && (*p == ' ' || *p == '\t')) ++p;
             if (p >= end || *p == '\n') break;
             bool neg = false;
             if (*p == '-') { neg = true; ++p; }
+            else if (*p == '+') { ++p; }
             int64_t v = 0;
             bool digits = false;
             while (p < end && *p >= '0' && *p <= '9') {
@@ -46,14 +51,16 @@ int64_t gs_parse_edges(const char* buf, int64_t len, int64_t max_edges,
                 ++p;
                 digits = true;
             }
-            if (!digits) {  // malformed token: skip to end of line
-                while (p < end && *p != '\n') ++p;
+            if (!digits || (p < end && *p != ' ' && *p != '\t' &&
+                            *p != '\n' && *p != '\r')) {
+                // malformed token among the first three: drop the line
                 nfields = -1;
                 break;
             }
-            if (nfields < 3) fields[nfields] = neg ? -v : v;
+            fields[nfields] = neg ? -v : v;
             ++nfields;
         }
+        while (p < end && *p != '\n') ++p;  // discard the rest of the line
         if (nfields >= 2) {
             src[count] = fields[0];
             dst[count] = fields[1];

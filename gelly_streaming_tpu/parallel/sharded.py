@@ -167,7 +167,10 @@ class ShardedWindowEngine:
         }
 
     def load_state_dict(self, state: dict) -> None:
-        assert state["vb"] == self.vb, "vertex bucket mismatch"
+        if state["vb"] != self.vb:
+            raise ValueError(
+                f"vertex bucket mismatch: checkpoint has {state['vb']}, "
+                f"engine built with {self.vb}")
         self._degree_state = jnp.asarray(state["degree_state"])
         self._labels = jnp.asarray(state["labels"])
 

@@ -35,25 +35,24 @@ def _unkey(pair):
     return int(k) if kind == "i" else k
 
 
-def _flatten(tree: Any, prefix: str, arrays: Dict[str, np.ndarray]):
+def _flatten(tree: Any, arrays: Dict[str, np.ndarray]):
     if isinstance(tree, dict):
         return {
             "t": "dict",
             "items": [
-                [_key(k), _flatten(v, f"{prefix}.{k}", arrays)]
-                for k, v in tree.items()
+                [_key(k), _flatten(v, arrays)] for k, v in tree.items()
             ],
         }
     if isinstance(tree, np.ndarray):
-        arrays[prefix] = tree
-        return {"t": "array", "key": prefix}
+        # sequential keys: path-derived strings can collide ("a.b" key vs
+        # nested a→b), silently dropping a leaf on restore
+        key = f"a{len(arrays)}"
+        arrays[key] = tree
+        return {"t": "array", "key": key}
     if isinstance(tree, (list, tuple)):
         return {
             "t": "list" if isinstance(tree, list) else "tuple",
-            "items": [
-                _flatten(v, f"{prefix}[{i}]", arrays)
-                for i, v in enumerate(tree)
-            ],
+            "items": [_flatten(v, arrays) for v in tree],
         }
     if isinstance(tree, (int, float, str, bool)) or tree is None:
         return {"t": "scalar", "v": tree}
@@ -77,7 +76,7 @@ def _unflatten(node: dict, arrays) -> Any:
 
 def save(path: str, tree: Any) -> None:
     arrays: Dict[str, np.ndarray] = {}
-    spec = _flatten(tree, "r", arrays)
+    spec = _flatten(tree, arrays)
     arrays[_ARRAY_KEY + "spec"] = np.frombuffer(
         json.dumps(spec).encode(), dtype=np.uint8
     )

@@ -41,6 +41,28 @@ def test_checkpoint_roundtrip_tree(tmp_path):
     assert back["tup"][1] is False
 
 
+def test_checkpoint_colliding_paths(tmp_path):
+    """Keys whose flattened path strings coincide ("a.b" vs nested a→b,
+    int 1 vs str "1") must survive independently."""
+    tree = {
+        "a": {"b": np.zeros(3, np.int32)},
+        "a.b": np.ones(3, np.int32),
+        1: np.full(2, 7, np.int32),
+        "1": np.full(2, 9, np.int32),
+        "x": [np.array([1])],
+        "x[0]": np.array([2]),
+    }
+    path = str(tmp_path / "collide.npz")
+    checkpoint.save(path, tree)
+    back = checkpoint.restore(path)
+    np.testing.assert_array_equal(back["a"]["b"], np.zeros(3))
+    np.testing.assert_array_equal(back["a.b"], np.ones(3))
+    np.testing.assert_array_equal(back[1], [7, 7])
+    np.testing.assert_array_equal(back["1"], [9, 9])
+    np.testing.assert_array_equal(back["x"][0], [1])
+    np.testing.assert_array_equal(back["x[0]"], [2])
+
+
 def test_disjoint_set_checkpoint():
     ds = DisjointSet()
     ds.union(1, 2)

@@ -38,6 +38,23 @@ def test_parse_large_roundtrip(tmp_path):
     np.testing.assert_array_equal(t, ts)
 
 
+def test_parse_trailing_tokens_match_python_fallback(tmp_path):
+    """Lines with extra non-numeric columns keep their first three fields
+    identically in the native parser and the Python fallback."""
+    p = tmp_path / "annot.txt"
+    p.write_text("1 2 100 label\n3 4 200 x y z\n5 6x 300\n7 8\n")
+    expected = ([1, 3, 7], [2, 4, 8], [100, 200, -1])
+    src, dst, ts = native.parse_edge_file(str(p))
+    np.testing.assert_array_equal(src, expected[0])
+    np.testing.assert_array_equal(dst, expected[1])
+    np.testing.assert_array_equal(ts, expected[2])
+    # and the pure-Python path agrees even when the native lib exists
+    s, d, t = native._parse_edge_file_py(str(p))
+    np.testing.assert_array_equal(s, expected[0])
+    np.testing.assert_array_equal(d, expected[1])
+    np.testing.assert_array_equal(t, expected[2])
+
+
 def test_assign_windows():
     ts = np.array([0, 99, 100, 250, 999, 1000])
     np.testing.assert_array_equal(
