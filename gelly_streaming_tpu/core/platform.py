@@ -20,6 +20,14 @@ def use_cpu() -> None:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+        # Import pallas while the TPU-family platform is still registered:
+        # its lowering-rule registration needs the 'tpu' mlir platform,
+        # which disappears once the tunnel factory is popped below.
+        try:
+            import jax.experimental.pallas  # noqa: F401
+            import jax.experimental.pallas.tpu  # noqa: F401
+        except Exception:
+            pass
         from jax._src import xla_bridge
 
         for name in [n for n in xla_bridge._backend_factories if n != "cpu"]:

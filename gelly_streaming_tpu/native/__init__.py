@@ -81,10 +81,10 @@ def _i32ptr(a: np.ndarray):
 def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Parse 'src dst [ts]' lines into int64 COO arrays (ts = -1 when
     missing). Native fast path; numpy loadtxt-style fallback."""
-    with open(path, "rb") as f:
-        data = f.read()
     lib = _load()
     if lib is not None:
+        with open(path, "rb") as f:
+            data = f.read()
         max_edges = data.count(b"\n") + 1
         src = np.empty(max_edges, np.int64)
         dst = np.empty(max_edges, np.int64)

@@ -39,7 +39,7 @@ int64_t gs_parse_edges(const char* buf, int64_t len, int64_t max_edges,
         // the Python fallback (native/__init__.py), so results cannot
         // depend on whether the native library is available.
         while (p < end && *p != '\n' && nfields < 3) {
-            while (p < end && (*p == ' ' || *p == '\t')) ++p;
+            while (p < end && (*p == ' ' || *p == '\t' || *p == '\r')) ++p;
             if (p >= end || *p == '\n') break;
             bool neg = false;
             if (*p == '-') { neg = true; ++p; }

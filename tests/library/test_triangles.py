@@ -74,7 +74,7 @@ def _brute_force(src, dst, n):
 
 
 @pytest.mark.parametrize("seed", range(5))
-@pytest.mark.parametrize("kernel", ["dense", "sparse"])
+@pytest.mark.parametrize("kernel", ["dense", "sparse", "pallas"])
 def test_kernels_vs_brute_force(seed, kernel):
     rng = np.random.default_rng(seed)
     n = 30
@@ -82,9 +82,48 @@ def test_kernels_vs_brute_force(seed, kernel):
     src = rng.integers(0, n, e)
     dst = rng.integers(0, n, e)
     expected = _brute_force(src, dst, n)
-    fn = (tri_ops.triangle_count_dense if kernel == "dense"
-          else tri_ops.triangle_count_sparse)
+    if kernel == "pallas":
+        from gelly_streaming_tpu.ops.pallas_triangles import \
+            triangle_count_dense_pallas as fn
+    else:
+        fn = (tri_ops.triangle_count_dense if kernel == "dense"
+              else tri_ops.triangle_count_sparse)
     assert fn(src, dst, n) == expected
+
+
+def test_streaming_window_kernel_matches_sparse():
+    """Fixed-shape streaming engine (one compile for all windows) agrees
+    with the dynamic host path across windows of varying size/shape."""
+    k = tri_ops.TriangleWindowKernel(edge_bucket=4096, vertex_bucket=512)
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        e = int(rng.integers(10, 4000))
+        src = rng.integers(0, 500, e)
+        dst = rng.integers(0, 500, e)
+        assert k.count(src, dst) == tri_ops.triangle_count_sparse(
+            src, dst, 512)
+    assert k.count(np.array([], np.int64), np.array([], np.int64)) == 0
+    # oversized window is rejected, not silently truncated
+    with pytest.raises(ValueError):
+        k.count(np.zeros(5000, np.int64), np.ones(5000, np.int64))
+
+
+def test_streaming_window_kernel_overflow_fallback():
+    """A hub whose oriented out-degree exceeds k_bucket must trigger the
+    exact fallback, not a wrong count."""
+    k = tri_ops.TriangleWindowKernel(edge_bucket=256, vertex_bucket=128,
+                                     k_bucket=8)
+    # star + clique: vertex 0 connects to everyone; 40-clique on 1..40
+    src, dst = [], []
+    for v in range(1, 100):
+        src.append(0)
+        dst.append(v)
+    for u in range(1, 41):
+        for v in range(u + 1, 41):
+            src.append(u)
+            dst.append(v)
+    src, dst = np.array(src[:256]), np.array(dst[:256])
+    assert k.count(src, dst) == _brute_force(src, dst, 128)
 
 
 def test_kernels_empty_and_tiny():

@@ -38,6 +38,18 @@ def test_parse_large_roundtrip(tmp_path):
     np.testing.assert_array_equal(t, ts)
 
 
+def test_parse_crlf_lines_match_python_fallback(tmp_path):
+    """CRLF-terminated lines (with and without timestamps) parse the same
+    through the native parser and the Python fallback."""
+    p = tmp_path / "crlf.txt"
+    p.write_bytes(b"1 2\r\n3 4 200\r\n5 6\r")
+    for parse in (native.parse_edge_file, native._parse_edge_file_py):
+        src, dst, ts = parse(str(p))
+        np.testing.assert_array_equal(src, [1, 3, 5])
+        np.testing.assert_array_equal(dst, [2, 4, 6])
+        np.testing.assert_array_equal(ts, [-1, 200, -1])
+
+
 def test_parse_trailing_tokens_match_python_fallback(tmp_path):
     """Lines with extra non-numeric columns keep their first three fields
     identically in the native parser and the Python fallback."""
