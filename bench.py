@@ -55,11 +55,24 @@ def make_stream(num_edges: int, num_vertices: int, seed: int = 7):
 
 
 def device_window_counts(kernel, src, dst, window_edges):
-    """Streaming device path: one fixed-shape program, raw COO in."""
-    return [
-        kernel.count(src[s:s + window_edges], dst[s:s + window_edges])
-        for s in range(0, len(src), window_edges)
-    ]
+    """Streaming device path: the whole stream's windows batched into
+    lax.map dispatches (kernel.count_stream) — one h2d per chunk, one
+    d2h of the counts, zero per-window round-trips."""
+    assert window_edges == kernel.eb, "stream windows must match the bucket"
+    return kernel.count_stream(src, dst)
+
+
+def warmup_stream_shapes(kernel, num_edges):
+    """Compile the (at most two) chunk shapes the timed run will use:
+    a full MAX_STREAM_WINDOWS chunk and the ragged final chunk."""
+    num_w = -(-num_edges // kernel.eb)
+    first = min(num_w, kernel.MAX_STREAM_WINDOWS)
+    zeros = np.zeros(first * kernel.eb, np.int32)
+    kernel.count_stream(zeros, zeros)
+    tail = num_w % kernel.MAX_STREAM_WINDOWS
+    if tail and tail != first:
+        zeros = zeros[: tail * kernel.eb]
+        kernel.count_stream(zeros, zeros)
 
 
 def cpu_reference_window_counts(src, dst, window_edges):
@@ -107,6 +120,10 @@ def main():
 
     kernel = TriangleWindowKernel(
         edge_bucket=window_edges, vertex_bucket=num_vertices)
+    # count_stream slices windows of exactly the kernel's edge bucket,
+    # so align the stream's window length to it (scales whose raw
+    # window_edges is not a power of two round up)
+    window_edges = kernel.eb
 
     # correctness cross-check + CPU baseline on shared sample windows
     # (small enough for the O(d²) candidate pipeline to finish)
@@ -116,13 +133,20 @@ def main():
     ref_counts = cpu_reference_window_counts(
         src[:sample], dst[:sample], sample_window)
     cpu_rate = sample / (time.perf_counter() - t0)
-    dev_counts = device_window_counts(
-        kernel, src[:sample], dst[:sample], sample_window)
+    # parity of BOTH device paths: the per-window escalating kernel and
+    # the batched lax.map streaming path the timed run uses
+    dev_counts = [
+        kernel.count(src[s:s + sample_window], dst[s:s + sample_window])
+        for s in range(0, sample, sample_window)
+    ]
     assert dev_counts == ref_counts, (dev_counts, ref_counts)
+    sample_kernel = TriangleWindowKernel(
+        edge_bucket=sample_window, vertex_bucket=num_vertices)
+    stream_counts = sample_kernel.count_stream(src[:sample], dst[:sample])
+    assert stream_counts == ref_counts, (stream_counts, ref_counts)
 
-    # warmup at full window shape (compile happens here), then timed run
-    device_window_counts(kernel, src[:window_edges], dst[:window_edges],
-                         window_edges)
+    # warmup at the exact chunk shapes of the timed run (compile here)
+    warmup_stream_shapes(kernel, num_edges)
     t0 = time.perf_counter()
     device_window_counts(kernel, src, dst, window_edges)
     elapsed = time.perf_counter() - t0

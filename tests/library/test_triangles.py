@@ -126,6 +126,49 @@ def test_streaming_window_kernel_overflow_fallback():
     assert k.count(src, dst) == _brute_force(src, dst, 128)
 
 
+def test_count_stream_matches_per_window_counts():
+    """Batched lax.map streaming path = per-window counts, including a
+    ragged tail window and the empty stream."""
+    k = tri_ops.TriangleWindowKernel(edge_bucket=512, vertex_bucket=256)
+    rng = np.random.default_rng(11)
+    e = 512 * 3 + 137  # three full windows + ragged tail
+    src = rng.integers(0, 200, e)
+    dst = rng.integers(0, 200, e)
+    expected = [k.count(src[s:s + 512], dst[s:s + 512])
+                for s in range(0, e, 512)]
+    assert k.count_stream(src, dst) == expected
+    assert k.count_stream(np.array([], np.int64), np.array([], np.int64)) == []
+
+
+def test_count_stream_overflow_windows_recounted_exactly():
+    """Windows whose hubs outrun K are redone exactly; clean windows in
+    the same chunk keep their batched counts."""
+    k = tri_ops.TriangleWindowKernel(edge_bucket=256, vertex_bucket=128,
+                                     k_bucket=8)
+    rng = np.random.default_rng(3)
+    # window 0: random sparse (fits K); window 1: 40-clique (overflows)
+    s0 = rng.integers(0, 100, 256)
+    d0 = rng.integers(0, 100, 256)
+    s1, d1 = [], []
+    for u in range(1, 41):
+        for v in range(u + 1, 41):
+            s1.append(u)
+            d1.append(v)
+    s1, d1 = np.array(s1[:256]), np.array(d1[:256])
+    src = np.concatenate([s0, s1])
+    dst = np.concatenate([d0, d1])
+    assert k.count_stream(src, dst) == [
+        _brute_force(s0, d0, 128), _brute_force(s1, d1, 128)]
+
+
+def test_escalation_ladder_widens_to_kmax():
+    k = tri_ops.TriangleWindowKernel(edge_bucket=4096, vertex_bucket=512,
+                                     k_bucket=8)
+    ladder = k._escalation_ladder()
+    assert ladder[0] == 8 and ladder[-1] >= k.kb_max
+    assert all(b > a for a, b in zip(ladder, ladder[1:]))
+
+
 def test_kernels_empty_and_tiny():
     assert tri_ops.triangle_count_sparse(np.array([]), np.array([]), 0) == 0
     assert tri_ops.triangle_count_dense(np.array([0]), np.array([1]), 2) == 0
