@@ -168,6 +168,45 @@ def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,
 
 
 # ----------------------------------------------------------------------
+# shared pipeline stages (single-chip kernel + sharded engine)
+# ----------------------------------------------------------------------
+
+def orient_by_degree(s: jax.Array, d: jax.Array, deg: jax.Array,
+                     sent: int):
+    """Orient each edge low(deg, id) → high(deg, id); sentinel maps to
+    itself. One source of truth for the tie-break used by both the
+    single-chip and the sharded kernel."""
+    lo = jnp.minimum(s, d)
+    hi = jnp.maximum(s, d)
+    swap = (deg[lo] > deg[hi]) | ((deg[lo] == deg[hi]) & (lo > hi))
+    return jnp.where(swap, hi, lo), jnp.where(swap, lo, hi)
+
+
+def dedupe_pairs(a: jax.Array, b: jax.Array, sent: int):
+    """Lexicographic sort + first-occurrence dedupe; duplicates become
+    (sent, sent) and a final re-sort leaves the survivors contiguous."""
+    a, b = jax.lax.sort((a, b), num_keys=2)
+    first = jnp.concatenate([
+        jnp.array([True]),
+        (a[1:] != a[:-1]) | (b[1:] != b[:-1]),
+    ])
+    evalid = first & (a < sent)
+    a = jnp.where(evalid, a, sent)
+    b = jnp.where(evalid, b, sent)
+    return jax.lax.sort((a, b), num_keys=2)
+
+
+def csr_positions(a: jax.Array, sent: int, vb: int):
+    """Per-edge column index within its source's contiguous run (edges
+    must be sorted by (a, b))."""
+    n = a.shape[0]
+    idx = jnp.arange(n)
+    seg_first = jax.ops.segment_min(
+        jnp.where(a < sent, idx, n), a, vb + 1)
+    return idx - seg_first[a]
+
+
+# ----------------------------------------------------------------------
 # streaming fixed-shape engine: the whole window pipeline on device
 # ----------------------------------------------------------------------
 
@@ -233,29 +272,11 @@ class TriangleWindowKernel:
             deg = deg + jax.ops.segment_sum(ones, dst, vb + 1)
 
             # ---- orient low(deg, id) -> high(deg, id)
-            lo = jnp.minimum(src, dst)
-            hi = jnp.maximum(src, dst)
-            swap = (deg[lo] > deg[hi]) | ((deg[lo] == deg[hi]) & (lo > hi))
-            a = jnp.where(swap, hi, lo)
-            b = jnp.where(swap, lo, hi)
+            a, b = orient_by_degree(src, dst, deg, sent)
 
-            # ---- lexicographic sort by (a, b); dedupe by neighbor change
-            a, b = jax.lax.sort((a, b), num_keys=2)
-            first = jnp.concatenate([
-                jnp.array([True]),
-                (a[1:] != a[:-1]) | (b[1:] != b[:-1]),
-            ])
-            evalid = first & (a < sent)
-            a = jnp.where(evalid, a, sent)
-            b = jnp.where(evalid, b, sent)
-            # re-sort so the deduped edges are contiguous by (a, b)
-            a, b = jax.lax.sort((a, b), num_keys=2)
-
-            # ---- CSR scatter: column = index within a's run
-            idx = jnp.arange(eb)
-            seg_first = jax.ops.segment_min(
-                jnp.where(a < sent, idx, eb), a, vb + 1)
-            pos = idx - seg_first[a]
+            # ---- sort/dedupe, then CSR column positions within runs
+            a, b = dedupe_pairs(a, b, sent)
+            pos = csr_positions(a, sent, vb)
             overflow = jnp.sum((pos >= kb) & (a < sent))
             ok = (a < sent) & (pos < kb)
             rows = jnp.where(ok, a, vb)

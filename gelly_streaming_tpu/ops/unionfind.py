@@ -29,11 +29,19 @@ from . import segment as seg_ops
 
 
 def cc_round(labels: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
-    """One local min-label sweep: scatter-min each edge's smaller label to
-    both endpoints. Shared by the single-chip loop, the sharded loop
-    (which adds a pmin exchange per round), and the fused entry step."""
-    m = jnp.minimum(labels[src], labels[dst])
-    return labels.at[src].min(m).at[dst].min(m)
+    """One local min-label sweep: scatter-min each edge's smaller label
+    to both endpoints AND to both endpoints' current roots
+    (Shiloach-Vishkin hooking). The root hook matters for carried
+    state: when a new edge merges two already-flat forests through
+    non-root members, only the root relink lets the losing component's
+    untouched members reach the smaller label via pointer jumping.
+    Shared by the single-chip loop, the sharded loop (which adds a pmin
+    exchange per round), and the fused entry step."""
+    ls = labels[src]
+    ld = labels[dst]
+    m = jnp.minimum(ls, ld)
+    return (labels.at[src].min(m).at[dst].min(m)
+            .at[ls].min(m).at[ld].min(m))
 
 
 def cc_fixpoint(labels0: jax.Array, src: jax.Array, dst: jax.Array,
@@ -104,6 +112,32 @@ def connected_components_with_labels(src: np.ndarray, dst: np.ndarray,
     return out[:num_vertices]
 
 
+def double_cover_edges(src: np.ndarray, dst: np.ndarray,
+                       num_vertices: int):
+    """Build the bipartite double cover's edge list: (u,+)=u, (u,-)=u+v;
+    edge u~w joins (u,+)-(w,-) and (u,-)-(w,+). Shared by the host
+    and sharded bipartiteness paths."""
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    v = num_vertices
+    return np.concatenate([src, src + v]), np.concatenate([dst + v, dst])
+
+
+def decode_double_cover(lab2: np.ndarray, num_vertices: int):
+    """(labels, signs, odd) from converged cover labels [>= 2·v].
+
+    For a bipartite component with min vertex m: the (+) cover of m's
+    side and the (−) cover of the other side form one cover component
+    whose min index is m itself; the other cover component's min index
+    is the other side's min vertex m2 > m. Hence both cover labels are
+    < v, their min is the component's min vertex, and a vertex is on
+    the root's side iff its (+) cover carries the smaller label. An odd
+    cycle collapses both covers into one component (plus == minus)."""
+    v = num_vertices
+    plus, minus = lab2[:v], lab2[v:2 * v]
+    return np.minimum(plus, minus), plus <= minus, plus == minus
+
+
 def bipartite_labels(src: np.ndarray, dst: np.ndarray, num_vertices: int):
     """2-coloring via the double cover.
 
@@ -112,21 +146,6 @@ def bipartite_labels(src: np.ndarray, dst: np.ndarray, num_vertices: int):
     side of the bipartition relative to the component's minimum vertex,
     and `odd[v]` True iff v's component contains an odd cycle.
     """
-    src = np.asarray(src, np.int64)
-    dst = np.asarray(dst, np.int64)
-    v = num_vertices
-    # double cover: (u,+)=u, (u,-)=u+v; edge u~w joins (u,+)-(w,-), (u,-)-(w,+)
-    s2 = np.concatenate([src, src + v])
-    d2 = np.concatenate([dst + v, dst])
-    lab2 = connected_components(s2, d2, 2 * v)
-    plus, minus = lab2[:v], lab2[v:]
-    odd = plus == minus
-    # For a bipartite component with min vertex m: the (+) cover of m's
-    # side and the (−) cover of the other side form one cover component
-    # whose min index is m itself; the other cover component's min index
-    # is the other side's min vertex m2 > m. Hence both cover labels are
-    # < v, their min is the component's min vertex, and a vertex is on
-    # the root's side iff its (+) cover carries the smaller label.
-    labels = np.minimum(plus, minus)
-    signs = plus <= minus
-    return labels, signs, odd
+    s2, d2 = double_cover_edges(src, dst, num_vertices)
+    lab2 = connected_components(s2, d2, 2 * num_vertices)
+    return decode_double_cover(lab2, num_vertices)

@@ -147,7 +147,7 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()),
     )
     def step(src, dst, valid):
         me = jax.lax.axis_index(SHARD_AXIS)
@@ -165,11 +165,8 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
         deg = jax.lax.psum(local_deg, SHARD_AXIS)
 
         # ---- orient low(deg, id) -> high(deg, id)
-        lo = jnp.minimum(s, d)
-        hi = jnp.maximum(s, d)
-        swap = (deg[lo] > deg[hi]) | ((deg[lo] == deg[hi]) & (lo > hi))
-        a = jnp.where(swap, hi, lo).astype(jnp.int32)
-        b = jnp.where(swap, lo, hi).astype(jnp.int32)
+        a, b = triangles.orient_by_degree(s, d, deg, sent)
+        a, b = a.astype(jnp.int32), b.astype(jnp.int32)
 
         # ---- owner shard by multiplicative pair hash: duplicates of an
         # edge land on one shard regardless of origin, so dedup is local
@@ -200,22 +197,10 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
             split_axis=0, concat_axis=0, tiled=True).reshape(n * cap)
 
         # ---- local dedupe of owned edges (global dedup by ownership)
-        ra, rb = jax.lax.sort((recv_a, recv_b), num_keys=2)
-        first = jnp.concatenate([
-            jnp.array([True]),
-            (ra[1:] != ra[:-1]) | (rb[1:] != rb[:-1]),
-        ])
-        evalid = first & (ra < sent)
-        ra = jnp.where(evalid, ra, sent)
-        rb = jnp.where(evalid, rb, sent)
-        ra, rb = jax.lax.sort((ra, rb), num_keys=2)
+        ra, rb = triangles.dedupe_pairs(recv_a, recv_b, sent)
 
         # ---- CSR scatter into this shard's kb/n column slice
-        er = n * cap
-        idx2 = jnp.arange(er)
-        seg_first = jax.ops.segment_min(
-            jnp.where(ra < sent, idx2, er), ra, vb + 1)
-        pos2 = idx2 - seg_first[ra]
+        pos2 = triangles.csr_positions(ra, sent, vb)
         k_overflow = jnp.sum((pos2 >= kslice) & (ra < sent))
         ok2 = (ra < sent) & (pos2 < kslice)
         rows = jnp.where(ok2, ra, vb)
@@ -230,8 +215,11 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
         # ---- each shard intersects the edges it owns; psum the partials
         local = triangles.intersect_local(nbr, ra, rb, ra < sent)
         count = jax.lax.psum(local, SHARD_AXIS)
-        overflow = jax.lax.psum(bucket_overflow + k_overflow, SHARD_AXIS)
-        return count, overflow
+        # separate signals so the host widens only the dimension that
+        # overflowed (cap vs K): each (kb, cap) pair is a fresh compile
+        bucket_overflow = jax.lax.psum(bucket_overflow, SHARD_AXIS)
+        k_overflow = jax.lax.psum(k_overflow, SHARD_AXIS)
+        return count, bucket_overflow, k_overflow
 
     return jax.jit(step)
 
@@ -281,13 +269,18 @@ class ShardedTriangleWindowKernel:
         s, d, valid = jnp.asarray(s), jnp.asarray(d), jnp.asarray(valid)
         kb, cap = self.kb, self.cap
         while True:
-            count, overflow = self._fn(kb, cap)(s, d, valid)
-            if not int(overflow):
+            count, bucket_ovf, k_ovf = self._fn(kb, cap)(s, d, valid)
+            bucket_ovf, k_ovf = int(bucket_ovf), int(k_ovf)
+            if not bucket_ovf and not k_ovf:
                 return int(count)
-            if kb >= self.kb_max and cap >= self.eb // self.n:
-                break  # a shard would hold every edge: host path instead
-            kb = min(-(-(kb * 4) // self.n) * self.n, self.kb_max)
-            cap = min(cap * 2, self.eb // self.n)
+            kb_sat = kb >= self.kb_max
+            cap_sat = cap >= self.eb // self.n
+            if (kb_sat or not k_ovf) and (cap_sat or not bucket_ovf):
+                break  # nothing left to widen: exact host path instead
+            if k_ovf and not kb_sat:
+                kb = min(-(-(kb * 4) // self.n) * self.n, self.kb_max)
+            if bucket_ovf and not cap_sat:
+                cap = min(cap * 2, self.eb // self.n)
         return triangles.triangle_count_sparse(src, dst, self.vb)
 
 
@@ -310,6 +303,10 @@ class ShardedWindowEngine:
         self.tri_fn = make_sharded_triangle_fn(self.mesh)
         self._degree_state = jnp.zeros(self.vb + 2, jnp.int32)
         self._labels = jnp.arange(self.vb + 2, dtype=jnp.int32)
+        # bipartite double cover runs CC over 2·vb cover vertices
+        # (ops/unionfind.bipartite_labels, sharded): built lazily
+        self._bip_fn = None
+        self._bip_labels = None
 
     def _prep(self, src, dst):
         src, dst = pad_edges_for_mesh(
@@ -336,15 +333,43 @@ class ShardedWindowEngine:
         self._labels = self.cc_fn(s, d, labels)
         return np.asarray(self._labels[: self.vb])
 
+    def bipartite(self, src, dst, carry: bool = True):
+        """Sharded bipartiteness via the double cover: edge u~w joins
+        (u,+)-(w,-) and (u,-)-(w,+) over 2·vb cover vertices; a vertex
+        whose covers share a component sits on an odd cycle
+        (ops/unionfind.bipartite_labels, distributed with the same
+        pmin label exchange as cc; replaces the reference's O(C²·V)
+        Candidates.merge, example/util/Candidates.java:76-138).
+
+        Returns (labels[vb], signs[vb], odd[vb]); carry=True folds the
+        window into the running cover labeling (streaming semantics of
+        the merge tree)."""
+        if self._bip_fn is None:
+            self._bip_fn = make_sharded_cc_fn(self.mesh, 2 * self.vb)
+        fresh = jnp.arange(2 * self.vb + 2, dtype=jnp.int32)
+        labels = self._bip_labels if (carry and self._bip_labels
+                                      is not None) else fresh
+        s2, d2 = unionfind.double_cover_edges(src, dst, self.vb)
+        s2, d2 = pad_edges_for_mesh(s2.astype(np.int32),
+                                    d2.astype(np.int32), self.mesh,
+                                    sentinel=2 * self.vb + 1)
+        self._bip_labels = self._bip_fn(jnp.asarray(s2), jnp.asarray(d2),
+                                        labels)
+        return unionfind.decode_double_cover(
+            np.asarray(self._bip_labels), self.vb)
+
     # ------------------------------------------------------------------
     # checkpoint / resume (utils/checkpoint.py)
     # ------------------------------------------------------------------
     def state_dict(self) -> dict:
-        return {
+        state = {
             "vb": self.vb,
             "degree_state": np.asarray(self._degree_state),
             "labels": np.asarray(self._labels),
         }
+        if self._bip_labels is not None:
+            state["bip_labels"] = np.asarray(self._bip_labels)
+        return state
 
     def load_state_dict(self, state: dict) -> None:
         if state["vb"] != self.vb:
@@ -353,6 +378,10 @@ class ShardedWindowEngine:
                 f"engine built with {self.vb}")
         self._degree_state = jnp.asarray(state["degree_state"])
         self._labels = jnp.asarray(state["labels"])
+        # restore must be symmetric: a checkpoint taken before any
+        # bipartite call clears post-checkpoint cover state
+        self._bip_labels = (jnp.asarray(state["bip_labels"])
+                            if "bip_labels" in state else None)
 
     def triangles(self, nbr, ea, eb, emask) -> int:
         target = mesh_padded_len(len(ea), self.mesh)

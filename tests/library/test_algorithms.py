@@ -90,3 +90,19 @@ def test_cc_incremental_windows():
     comps1 = sorted(sorted(m) for m in states[1].components().values())
     assert comps0 == [[1, 2], [3, 4]]
     assert comps1 == [[1, 2, 3, 4]]
+
+
+def test_carried_labels_merge_through_non_root_members():
+    """Regression: merging two flat label forests via an edge between
+    NON-root members must relabel the losing component's untouched
+    members (Shiloach-Vishkin root hook in ops/unionfind.cc_round).
+    Without the hook, vertex 1 below keeps label 1 forever."""
+    import numpy as np
+
+    from gelly_streaming_tpu.ops import unionfind
+
+    # two converged flat forests: {0,5}->0 and {1,6}->1
+    labels = np.array([0, 1, 2, 3, 4, 0, 1, 7], np.int32)
+    out = unionfind.connected_components_with_labels(
+        np.array([5]), np.array([6]), labels, 8)
+    assert list(out[[0, 1, 5, 6]]) == [0, 0, 0, 0]

@@ -126,5 +126,25 @@ def test_sharded_window_pipeline_non_power_of_two_mesh():
     assert k.count(src, dst) == tri_ops.triangle_count_sparse(src, dst, 64)
 
 
+def test_sharded_bipartite_matches_host():
+    from gelly_streaming_tpu.ops import unionfind
+
+    engine = ShardedWindowEngine(make_mesh(), num_vertices_bucket=32)
+    # even cycle 0-1-2-3-0 (bipartite) + odd cycle 10-11-12-10
+    src = np.array([0, 1, 2, 3, 10, 11, 12])
+    dst = np.array([1, 2, 3, 0, 11, 12, 10])
+    labels, signs, odd = engine.bipartite(src, dst, carry=False)
+    hl, hs, ho = unionfind.bipartite_labels(src, dst, 32)
+    np.testing.assert_array_equal(labels, hl)
+    np.testing.assert_array_equal(odd, ho)
+    assert not odd[0] and odd[10]
+    # signs 2-color the even cycle
+    assert signs[0] == signs[2] != signs[1] == signs[3]
+    # carried window: an edge joining both sides of the even cycle at
+    # odd distance makes it odd (streaming merge-tree semantics)
+    _, _, odd2 = engine.bipartite(np.array([0]), np.array([2]), carry=True)
+    assert odd2[0] and odd2[1]
+
+
 def test_mesh_uses_all_devices():
     assert len(jax.devices()) == 8
