@@ -365,7 +365,8 @@ class TriangleWindowKernel:
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
             c, o = fn(jnp.asarray(s[at:hi]), jnp.asarray(d[at:hi]),
                       jnp.asarray(valid[at:hi]))
-            c, o = np.asarray(c), np.asarray(o)
+            # np.array (not asarray): device outputs can be read-only
+            c, o = np.array(c), np.array(o)
             for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
                 lo_e = (at + int(w)) * self.eb
                 c[w] = self.count(src[lo_e:lo_e + self.eb],

@@ -256,18 +256,33 @@ class ShardedTriangleWindowKernel:
                 self.mesh, self.eb, self.vb, kb, cap)
         return self._fns[key]
 
-    def count(self, src: np.ndarray, dst: np.ndarray) -> int:
+    def _next_kb(self, kb: int) -> int:
+        return min(-(-(kb * 4) // self.n) * self.n, self.kb_max)
+
+    def _next_cap(self, cap: int) -> int:
+        return min(cap * 2, self.eb // self.n)
+
+    def count(self, src: np.ndarray, dst: np.ndarray,
+              failed_kb: int = 0, failed_cap: int = 0) -> int:
+        """failed_kb/failed_cap mark rungs a batched count_stream
+        dispatch already saw overflow, so the ladder starts past them
+        (or goes straight to the exact host path when that dimension
+        was already saturated)."""
         n = len(src)
         if n == 0:
             return 0
         if n > self.eb:
             raise ValueError(f"window of {n} edges exceeds edge bucket "
                              f"{self.eb}")
+        if ((failed_kb and failed_kb >= self.kb_max)
+                or (failed_cap and failed_cap >= self.eb // self.n)):
+            return triangles.triangle_count_sparse(src, dst, self.vb)
         s = seg_ops.pad_to(np.asarray(src, np.int32), self.eb, fill=self.vb)
         d = seg_ops.pad_to(np.asarray(dst, np.int32), self.eb, fill=self.vb)
         valid = seg_ops.pad_to(np.ones(n, bool), self.eb, fill=False)
         s, d, valid = jnp.asarray(s), jnp.asarray(d), jnp.asarray(valid)
-        kb, cap = self.kb, self.cap
+        kb = self._next_kb(failed_kb) if failed_kb else self.kb
+        cap = self._next_cap(failed_cap) if failed_cap else self.cap
         while True:
             count, bucket_ovf, k_ovf = self._fn(kb, cap)(s, d, valid)
             bucket_ovf, k_ovf = int(bucket_ovf), int(k_ovf)
@@ -278,10 +293,65 @@ class ShardedTriangleWindowKernel:
             if (kb_sat or not k_ovf) and (cap_sat or not bucket_ovf):
                 break  # nothing left to widen: exact host path instead
             if k_ovf and not kb_sat:
-                kb = min(-(-(kb * 4) // self.n) * self.n, self.kb_max)
+                kb = self._next_kb(kb)
             if bucket_ovf and not cap_sat:
-                cap = min(cap * 2, self.eb // self.n)
+                cap = self._next_cap(cap)
         return triangles.triangle_count_sparse(src, dst, self.vb)
+
+    MAX_STREAM_WINDOWS = 64
+
+    def _stream_fn(self, kb, cap):
+        key = ("stream", kb, cap)
+        if key not in self._fns:
+            window = self._fn(kb, cap)  # reuse the per-window compile cache
+
+            @jax.jit
+            def run_stream(src, dst, valid):  # [W, eb] each, edge-sharded
+                return jax.lax.map(lambda t: window(*t), (src, dst, valid))
+
+            self._fns[key] = run_stream
+        return self._fns[key]
+
+    def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
+        """Exact counts of every tumbling `edge_bucket`-sized window,
+        batched into one sharded program per MAX_STREAM_WINDOWS windows
+        (the multi-chip form of TriangleWindowKernel.count_stream): the
+        COO chunk is laid out [W, eb] with the edge axis sharded over
+        the mesh, a lax.map folds the windows, and overflowing windows
+        are recounted individually down the escalation ladder."""
+        from jax.sharding import NamedSharding
+
+        src = np.asarray(src, np.int32)
+        dst = np.asarray(dst, np.int32)
+        n = len(src)
+        if n == 0:
+            return []
+        num_w = -(-n // self.eb)
+        s = seg_ops.pad_to(src, num_w * self.eb, fill=self.vb)
+        d = seg_ops.pad_to(dst, num_w * self.eb, fill=self.vb)
+        valid = seg_ops.pad_to(np.ones(n, bool), num_w * self.eb,
+                               fill=False)
+        s = s.reshape(num_w, self.eb)
+        d = d.reshape(num_w, self.eb)
+        valid = valid.reshape(num_w, self.eb)
+        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+        fn = self._stream_fn(self.kb, self.cap)
+        counts: list = []
+        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
+            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
+            args = (jax.device_put(s[at:hi], sharding),
+                    jax.device_put(d[at:hi], sharding),
+                    jax.device_put(valid[at:hi], sharding))
+            # np.array (not asarray): device outputs are read-only views
+            c, b_ovf, k_ovf = (np.array(x) for x in fn(*args))
+            for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
+                lo_e = (at + int(w)) * self.eb
+                c[w] = self.count(
+                    src[lo_e:lo_e + self.eb], dst[lo_e:lo_e + self.eb],
+                    failed_kb=self.kb if int(k_ovf[w]) else 0,
+                    failed_cap=self.cap if int(b_ovf[w]) else 0)
+            counts.extend(int(x) for x in c)
+        return counts
 
 
 # ----------------------------------------------------------------------

@@ -114,6 +114,33 @@ def test_sharded_window_pipeline_escalates_on_hub_overflow():
     assert k.count(src, dst) == tri_ops.triangle_count_sparse(src, dst, 128)
 
 
+def test_sharded_count_stream_matches_per_window():
+    """Sharded batched lax.map streaming = per-window sharded counts =
+    host path, with a ragged tail and an overflowing clique window."""
+    mesh = make_mesh()
+    k = ShardedTriangleWindowKernel(mesh, edge_bucket=512,
+                                    vertex_bucket=128, k_bucket=8)
+    rng = np.random.default_rng(21)
+    s0 = rng.integers(0, 100, 512)
+    d0 = rng.integers(0, 100, 512)
+    s1, d1 = [], []
+    for u in range(1, 41):  # clique: overflows k_bucket=8
+        for v in range(u + 1, 41):
+            s1.append(u)
+            d1.append(v)
+    s1 = np.array(s1[:512])
+    d1 = np.array(d1[:512])
+    s2 = rng.integers(0, 100, 137)  # ragged tail
+    d2 = rng.integers(0, 100, 137)
+    src = np.concatenate([s0, s1, s2])
+    dst = np.concatenate([d0, d1, d2])
+    expected = [tri_ops.triangle_count_sparse(a, b, 128)
+                for a, b in ((s0, d0), (s1, d1), (s2, d2))]
+    assert k.count_stream(src, dst) == expected
+    assert k.count_stream(np.array([], np.int64),
+                          np.array([], np.int64)) == []
+
+
 def test_sharded_window_pipeline_non_power_of_two_mesh():
     """Shard counts that don't divide powers of two (e.g. 3) must work:
     buckets round up to multiples of the mesh size."""
