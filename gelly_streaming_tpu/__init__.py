@@ -16,6 +16,7 @@ Layers (SURVEY.md §1):
 """
 
 from .core.datastream import DataStream
+from .core.driver import StreamingAnalyticsDriver, WindowResult
 from .core.env import StreamEnvironment
 from .core.functions import (EdgesApply, EdgesFold, EdgesReduce,
                              JaxEdgesApply, JaxEdgesFold, JaxEdgesReduce)
@@ -32,5 +33,5 @@ __all__ = [
     "GraphStream", "GraphWindowStream", "SimpleEdgeStream",
     "AscendingTimestampExtractor", "ManualClock", "SystemClock", "Time",
     "TimeCharacteristic", "NULL", "Edge", "EdgeDirection", "NullValue",
-    "Vertex",
+    "Vertex", "StreamingAnalyticsDriver", "WindowResult",
 ]

@@ -1,0 +1,284 @@
+"""Columnar streaming analytics driver — the production ingest→device
+path.
+
+The record-level DataStream runtime (core/runtime.py) reproduces the
+reference's per-record operator semantics for API parity; this driver
+is the TPU-first way to run the same analytics at stream rate
+(SURVEY.md §7 design stance): no per-edge Python objects anywhere.
+
+    file → native parse (native/ingest.cpp)
+         → tumbling event-time window assignment (Flink TimeWindow
+           floor semantics, SimpleEdgeStream.java:90-94)
+         → incremental vertex interning (C++ hash map)
+         → per-window fixed-shape device kernels with carried state:
+             degrees   — running degree vector
+                         (continuous semantics of SimpleEdgeStream.java:465-482,
+                          emitted per window batch)
+             cc        — carried min-label components
+                         (library/ConnectedComponents.java via ops/unionfind)
+             bipartite — carried double-cover 2-coloring
+                         (library/BipartitenessCheck.java)
+             triangles — exact per-window count
+                         (example/WindowTriangles.java via ops/triangles)
+
+Single chip by default; pass a `jax.sharding.Mesh` to run every kernel
+sharded over it (parallel/sharded.py — P1 edges, P2/P6 collective
+merges). Vertex and edge buckets grow by doubling, so an unbounded
+stream triggers only O(log V) recompiles.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import native
+from ..ops import segment as seg_ops
+from ..ops import triangles as tri_ops
+from ..ops import unionfind
+from ..utils.interning import make_interner
+from ..utils.tracing import StepTimer
+
+
+@dataclasses.dataclass
+class WindowResult:
+    """Per-window analytics snapshot. Vertex-indexed arrays are in dense
+    slot order; `vertex_ids[slot]` maps back to external ids."""
+
+    window_start: int
+    num_edges: int
+    vertex_ids: np.ndarray                      # external id per slot
+    degrees: Optional[np.ndarray] = None        # running, per slot
+    cc_labels: Optional[np.ndarray] = None      # carried min-label slots
+    bipartite_odd: Optional[np.ndarray] = None  # carried odd-cycle flag
+    triangles: Optional[int] = None             # exact, this window only
+
+
+class StreamingAnalyticsDriver:
+    ANALYTICS = ("degrees", "cc", "bipartite", "triangles")
+
+    def __init__(self, window_ms: int,
+                 analytics: Sequence[str] = ANALYTICS,
+                 vertex_bucket: int = 1 << 12,
+                 edge_bucket: int = 1 << 12,
+                 mesh=None, tracing: bool = False):
+        unknown = set(analytics) - set(self.ANALYTICS)
+        if unknown:
+            raise ValueError(f"unknown analytics: {sorted(unknown)}")
+        self.window_ms = window_ms
+        self.analytics = tuple(analytics)
+        self.mesh = mesh
+        self.timer = StepTimer() if tracing else None
+        self.interner = make_interner(np.array([0]))
+        self.vb = seg_ops.bucket_size(vertex_bucket)
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self._degrees = np.zeros(0, np.int64)
+        self._cc = np.zeros(0, np.int32)
+        self._bip = np.zeros(0, np.int32)
+        self._tri_kernel = None
+        self._engine = None       # sharded: ShardedWindowEngine
+        self._sh_tri = None       # sharded: ShardedTriangleWindowKernel
+
+    # ------------------------------------------------------------------
+    # bucket growth (O(log V) recompiles over an unbounded stream)
+    # ------------------------------------------------------------------
+    def _ensure_buckets(self, num_vertices: int, window_edges: int) -> None:
+        vb_grew = eb_grew = False
+        while num_vertices > self.vb:
+            self.vb *= 2
+            vb_grew = True
+        while window_edges > self.eb:
+            self.eb *= 2
+            eb_grew = True
+        first = self._tri_kernel is None and self._engine is None
+        if not (vb_grew or eb_grew or first):
+            return
+        if self.mesh is not None:
+            from ..parallel.sharded import (ShardedTriangleWindowKernel,
+                                            ShardedWindowEngine)
+
+            if vb_grew or first:  # eb growth alone keeps the engine
+                old = self._engine
+                self._engine = ShardedWindowEngine(
+                    self.mesh, num_vertices_bucket=self.vb)
+                if old is not None:  # carry state into the wider bucket
+                    st = old.state_dict()
+                    new = self._engine.state_dict()
+                    n_deg = len(st["degree_state"]) - 2
+                    new["degree_state"][:n_deg] = st["degree_state"][:-2]
+                    lab = np.arange(self.vb + 2, dtype=np.int32)
+                    lab[:n_deg] = st["labels"][:-2]
+                    new["labels"] = lab
+                    if "bip_labels" in st:
+                        bl = np.arange(2 * self.vb + 2, dtype=np.int32)
+                        old_vb = n_deg
+                        old_bl = st["bip_labels"]
+                        # remap cover slots (v, v+old_vb) → (v, v+vb)
+                        shift = self.vb - old_vb
+                        remap = np.where(old_bl[:-2] >= old_vb,
+                                         old_bl[:-2] + shift, old_bl[:-2])
+                        bl[:old_vb] = remap[:old_vb]
+                        bl[self.vb:self.vb + old_vb] = remap[old_vb:]
+                        new["bip_labels"] = bl
+                    self._engine.load_state_dict(new)
+            if "triangles" in self.analytics:
+                self._sh_tri = ShardedTriangleWindowKernel(
+                    self.mesh, edge_bucket=self.eb,
+                    vertex_bucket=self.vb)
+        elif "triangles" in self.analytics:
+            self._tri_kernel = tri_ops.TriangleWindowKernel(
+                edge_bucket=self.eb, vertex_bucket=self.vb)
+
+    # ------------------------------------------------------------------
+    def run_file(self, path: str) -> List[WindowResult]:
+        src, dst, ts = native.parse_edge_file(path)
+        return self.run_arrays(src, dst, ts)
+
+    def run_arrays(self, src: np.ndarray, dst: np.ndarray,
+                   ts: Optional[np.ndarray] = None) -> List[WindowResult]:
+        """Process a (possibly partial) stream. With no timestamps,
+        windows are count-based `edge_bucket`-sized chunks (the
+        ingestion-time analog at a fixed batch rate)."""
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        if ts is not None and len(ts) and int(np.max(ts)) >= 0:
+            ts = np.asarray(ts, np.int64)
+            if int(np.min(ts)) < 0:
+                raise ValueError(
+                    "mixed timestamped and untimestamped rows: every "
+                    "edge needs a timestamp for event-time windows "
+                    "(rows without a third column parse as ts=-1)")
+            starts = native.assign_windows(np.asarray(ts, np.int64),
+                                           self.window_ms)
+            if np.any(np.diff(starts) < 0):
+                raise ValueError(
+                    "timestamps must be ascending (the reference's "
+                    "AscendingTimestampExtractor contract, "
+                    "SimpleEdgeStream.java:90-94)")
+            bounds = np.flatnonzero(np.diff(starts)) + 1
+            slices = np.split(np.arange(len(src)), bounds)
+            window_starts = [int(starts[s[0]]) for s in slices if len(s)]
+        else:
+            slices = [np.arange(i, min(i + self.eb, len(src)))
+                      for i in range(0, len(src), self.eb)]
+            window_starts = [int(i[0]) for i in slices if len(i)]
+        out = []
+        for wstart, idx in zip(window_starts, slices):
+            if len(idx):
+                out.append(self._window(wstart, src[idx], dst[idx]))
+        return out
+
+    # ------------------------------------------------------------------
+    def _step(self, name: str, num_records: int):
+        return (self.timer.step(name, num_records) if self.timer
+                else contextlib.nullcontext())
+
+    def _window(self, wstart: int, src: np.ndarray,
+                dst: np.ndarray) -> WindowResult:
+        with self._step("intern", 2 * len(src)):
+            s = self.interner.intern_array(src)
+            d = self.interner.intern_array(dst)
+        nv = len(self.interner)
+        self._ensure_buckets(nv, len(src))
+        res = WindowResult(
+            window_start=wstart, num_edges=len(src),
+            vertex_ids=np.asarray(self.interner.ids_of(
+                np.arange(nv, dtype=np.int32))),
+        )
+        for name in self.analytics:
+            with self._step(name, len(src)):
+                self._run_one(name, s, d, nv, res)
+        return res
+
+    def _run_one(self, name: str, s: np.ndarray, d: np.ndarray,
+                 nv: int, res: WindowResult) -> None:
+        sharded = self._engine is not None
+        if name == "degrees":
+            if sharded:
+                res.degrees = np.array(self._engine.degrees(s, d)[:nv])
+            else:
+                counts = (np.bincount(s, minlength=nv)
+                          + np.bincount(d, minlength=nv)).astype(np.int64)
+                if len(self._degrees) < nv:
+                    self._degrees = np.concatenate([
+                        self._degrees,
+                        np.zeros(nv - len(self._degrees), np.int64)])
+                self._degrees += counts
+                res.degrees = self._degrees.copy()
+        elif name == "cc":
+            if sharded:
+                res.cc_labels = np.array(self._engine.cc_labels(s, d)[:nv])
+            else:
+                if len(self._cc) < nv:
+                    self._cc = np.concatenate([
+                        self._cc,
+                        np.arange(len(self._cc), nv, dtype=np.int32)])
+                self._cc = unionfind.connected_components_with_labels(
+                    s, d, self._cc, nv)
+                res.cc_labels = self._cc.copy()
+        elif name == "bipartite":
+            if sharded:
+                _, _, odd = self._engine.bipartite(s, d)
+                res.bipartite_odd = np.array(odd[:nv])
+            else:
+                if len(self._bip) < 2 * nv:
+                    prev = len(self._bip) // 2
+                    cover = np.concatenate([
+                        self._bip[:prev],
+                        np.arange(prev, nv, dtype=np.int32),
+                        np.where(self._bip[prev:] >= prev,
+                                 self._bip[prev:] + (nv - prev),
+                                 self._bip[prev:]).astype(np.int32),
+                        np.arange(nv + prev, 2 * nv, dtype=np.int32)])
+                    self._bip = cover
+                s2, d2 = unionfind.double_cover_edges(s, d, nv)
+                self._bip = unionfind.connected_components_with_labels(
+                    s2, d2, self._bip, 2 * nv)
+                _, _, odd = unionfind.decode_double_cover(self._bip, nv)
+                res.bipartite_odd = odd
+        elif name == "triangles":
+            if sharded:
+                res.triangles = self._sh_tri.count(s, d)
+            else:
+                res.triangles = self._tri_kernel.count(s, d)
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (utils/checkpoint.py-compatible dict of arrays)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        nv = len(self.interner)
+        state = {
+            "window_ms": self.window_ms,
+            "analytics": list(self.analytics),
+            "vertex_ids": np.asarray(self.interner.ids_of(
+                np.arange(nv, dtype=np.int32))),
+            "degrees": self._degrees.copy(),
+            "cc": self._cc.copy(),
+            "bip": self._bip.copy(),
+        }
+        if self._engine is not None:
+            state["engine"] = self._engine.state_dict()
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        if state["window_ms"] != self.window_ms:
+            raise ValueError("window size mismatch")
+        if tuple(state["analytics"]) != self.analytics:
+            raise ValueError(
+                f"analytics mismatch: checkpoint has "
+                f"{state['analytics']}, driver runs {list(self.analytics)}")
+        self.interner = make_interner(np.array([0]))
+        self.interner.intern_array(np.asarray(state["vertex_ids"],
+                                              np.int64))
+        self._degrees = np.array(state["degrees"])
+        self._cc = np.array(state["cc"])
+        self._bip = np.array(state["bip"])
+        self._ensure_buckets(len(state["vertex_ids"]), 1)
+        if self._engine is not None and "engine" in state:
+            self._engine.load_state_dict(state["engine"])
+
+    def trace_report(self) -> List[dict]:
+        return self.timer.report() if self.timer else []
