@@ -39,6 +39,7 @@ def main(argv):
 
         mesh = make_mesh()
 
+    cleanup = None
     if argv:
         path = argv[0]
         window_ms = int(argv[1]) if len(argv) > 1 else 1000
@@ -46,6 +47,7 @@ def main(argv):
                      else StreamingAnalyticsDriver.ANALYTICS)
     else:
         print("Executing with built-in default data.")
+        import os
         import tempfile
 
         f = tempfile.NamedTemporaryFile("w", suffix=".txt", delete=False)
@@ -53,10 +55,16 @@ def main(argv):
         f.close()
         path, window_ms = f.name, 200
         analytics = StreamingAnalyticsDriver.ANALYTICS
+        cleanup = lambda: os.unlink(f.name)  # noqa: E731
 
     driver = StreamingAnalyticsDriver(window_ms, analytics=analytics,
                                       mesh=mesh, tracing=trace)
-    for res in driver.run_file(path):
+    try:
+        results = driver.run_file(path)
+    finally:
+        if cleanup:
+            cleanup()
+    for res in results:
         parts = [f"window={res.window_start}", f"edges={res.num_edges}"]
         if res.triangles is not None:
             parts.append(f"triangles={res.triangles}")

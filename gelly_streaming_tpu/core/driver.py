@@ -73,6 +73,7 @@ class StreamingAnalyticsDriver:
         self.mesh = mesh
         self.timer = StepTimer() if tracing else None
         self.interner = make_interner(np.array([0]))
+        self._ext_ids = np.zeros(0, np.int64)  # slot → external id cache
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.eb = seg_ops.bucket_size(edge_bucket)
         self._degrees = np.zeros(0, np.int64)
@@ -176,6 +177,17 @@ class StreamingAnalyticsDriver:
         return (self.timer.step(name, num_records) if self.timer
                 else contextlib.nullcontext())
 
+    def _vertex_ids(self, nv: int) -> np.ndarray:
+        """Slot → external-id table; slots are assigned once, so the
+        cache only extends by the slots added since the last window
+        (O(new) per window, not O(V))."""
+        have = len(self._ext_ids)
+        if nv > have:
+            fresh = np.asarray(self.interner.ids_of(
+                np.arange(have, nv, dtype=np.int32)))
+            self._ext_ids = np.concatenate([self._ext_ids, fresh])
+        return self._ext_ids[:nv]
+
     def _window(self, wstart: int, src: np.ndarray,
                 dst: np.ndarray) -> WindowResult:
         with self._step("intern", 2 * len(src)):
@@ -185,8 +197,7 @@ class StreamingAnalyticsDriver:
         self._ensure_buckets(nv, len(src))
         res = WindowResult(
             window_start=wstart, num_edges=len(src),
-            vertex_ids=np.asarray(self.interner.ids_of(
-                np.arange(nv, dtype=np.int32))),
+            vertex_ids=self._vertex_ids(nv),
         )
         for name in self.analytics:
             with self._step(name, len(src)):
@@ -249,12 +260,11 @@ class StreamingAnalyticsDriver:
     # checkpoint / resume (utils/checkpoint.py-compatible dict of arrays)
     # ------------------------------------------------------------------
     def state_dict(self) -> dict:
-        nv = len(self.interner)
         state = {
             "window_ms": self.window_ms,
             "analytics": list(self.analytics),
-            "vertex_ids": np.asarray(self.interner.ids_of(
-                np.arange(nv, dtype=np.int32))),
+            "sharded": self.mesh is not None,
+            "vertex_ids": np.array(self._vertex_ids(len(self.interner))),
             "degrees": self._degrees.copy(),
             "cc": self._cc.copy(),
             "bip": self._bip.copy(),
@@ -270,7 +280,16 @@ class StreamingAnalyticsDriver:
             raise ValueError(
                 f"analytics mismatch: checkpoint has "
                 f"{state['analytics']}, driver runs {list(self.analytics)}")
+        if state["sharded"] != (self.mesh is not None):
+            # carried state lives in different representations (host
+            # arrays vs engine device state); refuse rather than resume
+            # from silently-empty analytics
+            raise ValueError(
+                "checkpoint was taken in "
+                + ("sharded" if state["sharded"] else "single-chip")
+                + " mode; construct the driver in the same mode to resume")
         self.interner = make_interner(np.array([0]))
+        self._ext_ids = np.zeros(0, np.int64)
         self.interner.intern_array(np.asarray(state["vertex_ids"],
                                               np.int64))
         self._degrees = np.array(state["degrees"])

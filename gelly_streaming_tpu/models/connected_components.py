@@ -70,5 +70,8 @@ class TpuConnectedComponents(WindowGraphAggregation):
         labels = unionfind.connected_components(s_dense, d_dense, len(uniq))
         summary = DisjointSet()
         for v, root in zip(uniq.tolist(), uniq[labels].tolist()):
-            summary.union(v, root)
+            # root first: union-by-rank ties keep the component minimum
+            # as representative, so printed summaries match the host
+            # variant's typical output
+            summary.union(root, v)
         return summary

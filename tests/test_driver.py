@@ -125,3 +125,13 @@ def test_driver_tracing_and_file(tmp_path):
     assert [r.triangles for r in results] == [1, 0]
     report = drv.trace_report()
     assert {row["op"] for row in report} >= {"intern", "triangles"}
+
+
+def test_driver_cross_mode_checkpoint_refused():
+    a = StreamingAnalyticsDriver(window_ms=500)
+    a.run_arrays(np.array([1, 2]), np.array([2, 3]),
+                 np.array([100, 200]))
+    state = a.state_dict()
+    b = StreamingAnalyticsDriver(window_ms=500, mesh=make_mesh())
+    with pytest.raises(ValueError, match="single-chip mode"):
+        b.load_state_dict(state)
