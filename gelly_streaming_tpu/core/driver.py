@@ -186,7 +186,8 @@ class StreamingAnalyticsDriver:
             fresh = np.asarray(self.interner.ids_of(
                 np.arange(have, nv, dtype=np.int32)))
             self._ext_ids = np.concatenate([self._ext_ids, fresh])
-        return self._ext_ids[:nv]
+        # copy: WindowResult fields are snapshots, never live views
+        return self._ext_ids[:nv].copy()
 
     def _window(self, wstart: int, src: np.ndarray,
                 dst: np.ndarray) -> WindowResult:
@@ -280,7 +281,8 @@ class StreamingAnalyticsDriver:
             raise ValueError(
                 f"analytics mismatch: checkpoint has "
                 f"{state['analytics']}, driver runs {list(self.analytics)}")
-        if state["sharded"] != (self.mesh is not None):
+        # .get: checkpoints from before this key carried host-array state
+        if state.get("sharded", False) != (self.mesh is not None):
             # carried state lives in different representations (host
             # arrays vs engine device state); refuse rather than resume
             # from silently-empty analytics

@@ -1,0 +1,90 @@
+"""Multi-host (multi-slice) deployment: the DCN half of the
+communication backend.
+
+The reference scales out through Flink's TaskManager network over TCP
+(SURVEY.md §5.8); the TPU-native equivalent is a hybrid device mesh
+whose inner axis rides ICI (chips within a slice) and whose outer axis
+rides DCN (between slices/hosts), with the same `shard_map` kernels and
+collectives running unchanged on top (parallel/sharded.py):
+
+- edge shards (P1) split over ('dcn', 'shard') — a window's batch is
+  first striped across slices, then across a slice's chips;
+- collective merges (P2/P6) are sequenced by XLA so the psum/pmin/pmax
+  tree reduces over ICI first and crosses DCN once per window, the
+  same slice-then-global shape as the reference's per-TaskManager
+  pre-aggregation funnels.
+
+`initialize_runtime` wires `jax.distributed` (one process per host,
+coordinator at process 0) — the launch contract that replaces the
+reference's cluster deployment descriptors.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from .mesh import SHARD_AXIS
+
+DCN_AXIS = "dcn"
+
+
+def initialize_runtime(coordinator_address: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> None:
+    """Join the multi-host runtime (call once per host before any jax
+    computation; single-host callers never need this). Thin, explicit
+    wrapper over `jax.distributed.initialize` so deployments have one
+    framework entry point."""
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_hybrid_mesh(ici_shards: Optional[int] = None,
+                     dcn_shards: Optional[int] = None,
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """2-D ('dcn', 'shard') mesh: inner axis = chips connected by ICI,
+    outer axis = slices connected by DCN. Defaults: one DCN group per
+    process, all local devices on the ICI axis.
+
+    The sharded kernels (parallel/sharded.py) operate over the flat
+    edge axis; flatten_for_edges() gives the 1-D view whose collectives
+    XLA lowers to an ICI-first, DCN-once reduction tree.
+    """
+    devices = list(jax.devices()) if devices is None else list(devices)
+    if dcn_shards is None:
+        dcn_shards = max(jax.process_count(), 1)
+    if ici_shards is None:
+        ici_shards = len(devices) // dcn_shards
+    if ici_shards * dcn_shards != len(devices):
+        raise ValueError(
+            f"{ici_shards} ICI x {dcn_shards} DCN shards != "
+            f"{len(devices)} devices")
+    if dcn_shards > 1 and jax.process_count() > 1:
+        # process_is_granule=True: a DCN group is one process (host),
+        # matching the dcn_shards default above — slice_index-based
+        # granules would require dcn_shards == number of slices and the
+        # attribute to exist at all
+        arr = mesh_utils.create_hybrid_device_mesh(
+            (ici_shards,), (dcn_shards,), devices=devices,
+            process_is_granule=True)
+        arr = np.asarray(arr).reshape(dcn_shards, ici_shards)
+    else:  # single process: any contiguity works, DCN axis is logical
+        arr = np.asarray(devices).reshape(dcn_shards, ici_shards)
+    return Mesh(arr, (DCN_AXIS, SHARD_AXIS))
+
+
+def flatten_for_edges(mesh: Mesh) -> Mesh:
+    """1-D view of a hybrid mesh for the edge-sharded kernels: the
+    SHARD axis enumerates (dcn, ici) lexicographically, so consecutive
+    edge shards stay on ICI neighbors and cross-slice traffic happens
+    only at the collective tree's top level."""
+    devices = mesh.devices.reshape(-1)
+    return Mesh(devices, (SHARD_AXIS,))

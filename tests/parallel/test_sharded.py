@@ -175,3 +175,23 @@ def test_sharded_bipartite_matches_host():
 
 def test_mesh_uses_all_devices():
     assert len(jax.devices()) == 8
+
+
+def test_hybrid_mesh_single_process_shapes():
+    """Hybrid ('dcn','shard') mesh construction and its flat edge view;
+    the sharded kernels must run unchanged on the flattened mesh."""
+    from gelly_streaming_tpu.parallel import multihost
+
+    mesh = multihost.make_hybrid_mesh(ici_shards=4, dcn_shards=2)
+    assert mesh.shape == {"dcn": 2, "shard": 4}
+    flat = multihost.flatten_for_edges(mesh)
+    assert flat.shape == {"shard": 8}
+
+    k = ShardedTriangleWindowKernel(flat, edge_bucket=512,
+                                    vertex_bucket=64)
+    rng = np.random.default_rng(1)
+    src = rng.integers(0, 60, 500)
+    dst = rng.integers(0, 60, 500)
+    assert k.count(src, dst) == tri_ops.triangle_count_sparse(src, dst, 64)
+    with pytest.raises(ValueError, match="devices"):
+        multihost.make_hybrid_mesh(ici_shards=3, dcn_shards=2)
