@@ -29,6 +29,34 @@ def load_edge_arrays(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     return native.parse_edge_file(path)
 
 
+def iter_edge_chunks(path: str, chunk_bytes: int = 1 << 24):
+    """Stream a 'src dst [ts]' file as bounded-memory COO chunks: read
+    `chunk_bytes` at a time, cut at the last newline, parse with the
+    native parser. The unbounded-file ingestion the reference gets from
+    Flink's streaming file source — no full-file materialization."""
+    if chunk_bytes < 1:
+        raise ValueError("chunk_bytes must be >= 1")
+    remainder = b""
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk_bytes)
+            if not buf:
+                break
+            data = remainder + buf
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                remainder = data
+                continue
+            remainder = data[cut + 1:]
+            arrays = native.parse_edge_bytes(data[:cut + 1])
+            if len(arrays[0]):
+                yield arrays
+    if remainder:
+        arrays = native.parse_edge_bytes(remainder)
+        if len(arrays[0]):
+            yield arrays
+
+
 def read_edge_file(env, path: str,
                    event_time: bool = False) -> SimpleEdgeStream:
     """A SimpleEdgeStream over a 'src dst [ts]' file. With event_time,

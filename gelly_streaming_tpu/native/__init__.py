@@ -78,13 +78,13 @@ def _i32ptr(a: np.ndarray):
 
 
 # ----------------------------------------------------------------------
-def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Parse 'src dst [ts]' lines into int64 COO arrays (ts = -1 when
-    missing). Native fast path; numpy loadtxt-style fallback."""
+def parse_edge_bytes(data: bytes) -> Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+    """Parse a byte buffer of 'src dst [ts]' lines into int64 COO
+    arrays (ts = -1 when missing). Native fast path with a behavior-
+    identical Python fallback."""
     lib = _load()
     if lib is not None:
-        with open(path, "rb") as f:
-            data = f.read()
         max_edges = data.count(b"\n") + 1
         src = np.empty(max_edges, np.int64)
         dst = np.empty(max_edges, np.int64)
@@ -92,15 +92,20 @@ def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         n = lib.gs_parse_edges(data, len(data), max_edges,
                                _i64ptr(src), _i64ptr(dst), _i64ptr(ts))
         return src[:n].copy(), dst[:n].copy(), ts[:n].copy()
-    return _parse_edge_file_py(path)
+    return _parse_edge_bytes_py(data)
 
 
-def _parse_edge_file_py(path: str):
+def parse_edge_file(path: str) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Parse 'src dst [ts]' lines into int64 COO arrays (ts = -1 when
+    missing). Native fast path; numpy loadtxt-style fallback."""
+    with open(path, "rb") as f:
+        return parse_edge_bytes(f.read())
+
+
+def _parse_edge_bytes_py(data: bytes):
     """Pure-Python parser; must stay behaviorally identical to
     gs_parse_edges (ingest.cpp) so results never depend on whether the
     native library is available."""
-    with open(path, "rb") as f:
-        data = f.read()
     src_l, dst_l, ts_l = [], [], []
     for line in data.decode().splitlines():
         fields = line.split()

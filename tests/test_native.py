@@ -43,7 +43,8 @@ def test_parse_crlf_lines_match_python_fallback(tmp_path):
     through the native parser and the Python fallback."""
     p = tmp_path / "crlf.txt"
     p.write_bytes(b"1 2\r\n3 4 200\r\n5 6\r")
-    for parse in (native.parse_edge_file, native._parse_edge_file_py):
+    for parse in (native.parse_edge_file,
+                  lambda f: native._parse_edge_bytes_py(open(f, 'rb').read())):
         src, dst, ts = parse(str(p))
         np.testing.assert_array_equal(src, [1, 3, 5])
         np.testing.assert_array_equal(dst, [2, 4, 6])
@@ -61,7 +62,7 @@ def test_parse_trailing_tokens_match_python_fallback(tmp_path):
     np.testing.assert_array_equal(dst, expected[1])
     np.testing.assert_array_equal(ts, expected[2])
     # and the pure-Python path agrees even when the native lib exists
-    s, d, t = native._parse_edge_file_py(str(p))
+    s, d, t = native._parse_edge_bytes_py(p.read_bytes())
     np.testing.assert_array_equal(s, expected[0])
     np.testing.assert_array_equal(d, expected[1])
     np.testing.assert_array_equal(t, expected[2])
