@@ -113,6 +113,9 @@ class StreamingAnalyticsDriver:
                 if old is not None:  # carry state into the wider bucket
                     st = old.state_dict()
                     new = self._engine.state_dict()
+                    # np.array: the fresh state leaf is a read-only
+                    # device view; only degree_state is patched in place
+                    new["degree_state"] = np.array(new["degree_state"])
                     n_deg = len(st["degree_state"]) - 2
                     new["degree_state"][:n_deg] = st["degree_state"][:-2]
                     lab = np.arange(self.vb + 2, dtype=np.int32)

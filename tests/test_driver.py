@@ -236,3 +236,25 @@ def test_stream_file_resume_skips_processed_edges(tmp_path):
     np.testing.assert_array_equal(rest[-1].cc_labels, want[-1].cc_labels)
     np.testing.assert_array_equal(rest[-1].bipartite_odd,
                                   want[-1].bipartite_odd)
+
+
+def test_sharded_bucket_growth_carries_engine_state():
+    """Vertex-bucket growth AFTER the sharded engine exists must carry
+    degree/label/bipartite state into the wider bucket (regression:
+    read-only state_dict views + remap correctness)."""
+    drv = StreamingAnalyticsDriver(window_ms=0, mesh=make_mesh(),
+                                   vertex_bucket=8, edge_bucket=16)
+    # window 1: vertices 0..9 (grows 8→16 before engine exists is
+    # avoided by keeping nv <= 8 here)
+    drv.run_arrays(np.arange(4), np.arange(4) + 4)          # nv = 8
+    # window 2: new vertices force growth with live engine state
+    out = drv.run_arrays(np.arange(20), np.arange(20) + 20)  # nv = 40
+    single = StreamingAnalyticsDriver(window_ms=0, vertex_bucket=8,
+                                      edge_bucket=16)
+    single.run_arrays(np.arange(4), np.arange(4) + 4)
+    want = single.run_arrays(np.arange(20), np.arange(20) + 20)
+    np.testing.assert_array_equal(out[-1].degrees[:40],
+                                  want[-1].degrees[:40])
+    np.testing.assert_array_equal(out[-1].bipartite_odd[:40],
+                                  want[-1].bipartite_odd[:40])
+    assert out[-1].triangles == want[-1].triangles
