@@ -6,10 +6,13 @@ sources are absent from the snapshot; SURVEY.md §6) on the columnar
 streaming path.
 
 Usage: measurements.py [<workload> [<edges file> [window]]] [--sharded]
-       [--cpu]
+       [--fused] [--cpu]
 
   workload: degrees | cc | bipartite | triangles | all   (default all)
   window:   edges per count-based window (default 65536)
+  --fused:  run ALL analytics in one carried-state scan program per
+            64-window chunk (ops/scan_analytics.py) — the minimal-
+            transfer path; prints a single combined line
 
 Without a file, measures a synthetic power-law stream (zero-egress
 environment). Prints one JSON line per workload:
@@ -63,8 +66,37 @@ def measure(workload: str, src, dst, window_edges: int, mesh):
     }
 
 
+def measure_fused(src, dst, window_edges: int):
+    import numpy as np
+
+    from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+
+    eng = StreamSummaryEngine(
+        edge_bucket=window_edges,
+        vertex_bucket=int(max(src.max(), dst.max())) + 1)
+    # warmup at the EXACT chunk shapes the timed run will dispatch
+    # (full chunk + ragged final chunk), so no compile lands in timing
+    num_w = -(-len(src) // eng.eb)
+    for w in {min(num_w, eng.MAX_WINDOWS), num_w % eng.MAX_WINDOWS}:
+        if w:
+            zeros = np.zeros(w * eng.eb, np.int64)
+            eng.process(zeros, zeros)
+            eng.reset()
+    t0 = time.perf_counter()
+    results = eng.process(src, dst)
+    elapsed = time.perf_counter() - t0
+    return {
+        "workload": "fused(degrees+cc+bipartite+triangles)",
+        "edges_per_sec": round(len(src) / elapsed),
+        "windows": len(results),
+        "window_edges": eng.eb,
+        "edges": len(src),
+    }
+
+
 def main(argv):
     sharded = "--sharded" in argv
+    fused = "--fused" in argv
     argv = [a for a in argv if not a.startswith("--")]
     workload = argv[0] if argv else "all"
     path = argv[1] if len(argv) > 1 else None
@@ -83,6 +115,15 @@ def main(argv):
     else:
         src, dst = synthetic_stream(1 << 20, 1 << 17)
 
+    if fused:
+        if sharded:
+            sys.exit("--fused runs single-chip; drop --sharded or "
+                     "measure workloads separately")
+        if workload != "all":
+            sys.exit("--fused measures all analytics in one program; "
+                     "drop the workload argument or the flag")
+        print(json.dumps(measure_fused(src, dst, window_edges)))
+        return
     names = (["degrees", "cc", "bipartite", "triangles"]
              if workload == "all" else [workload])
     for name in names:

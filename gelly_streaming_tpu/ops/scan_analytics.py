@@ -1,0 +1,154 @@
+"""Fused multi-analytics streaming scan: every analytic, every window,
+ONE device dispatch per chunk.
+
+The driver's per-window calls (core/driver.py) pay one host↔device
+round trip per window per analytic — the dominant cost through a
+tunneled chip (ops/triangles.py docstring: ~0.2s/window). This engine
+generalizes `count_stream`'s batching to the full analytics suite: a
+`lax.scan` carries (degree vector, CC labels, double-cover labels)
+across a `[W, eb]` stack of windows and emits per-window summary
+scalars, so an entire chunk of stream costs one h2d of COO, one fused
+program, one d2h of `[W]` summaries.
+
+Summaries per window (all cumulative over the stream so far, matching
+the carried-state semantics of the reference's continuous aggregates):
+  max_degree      — max running degree (SimpleEdgeStream.java:465-482)
+  num_components  — count of touched roots (ConnectedComponents)
+  odd_cycle       — any odd cycle seen (BipartitenessCheck)
+  triangles       — exact count of THIS window (WindowTriangles)
+  tri_overflow    — hub outran the K bucket (host recounts exactly)
+
+Full per-vertex snapshots remain the driver's job; this engine is the
+throughput path (bench.py, examples/measurements.py --fused).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import segment as seg_ops
+from . import triangles as tri_ops
+from . import unionfind
+
+
+def _build_scan(eb: int, vb: int, kb: int):
+    """Scan body over fixed buckets. Cover layout: (+) side = v,
+    (−) side = vb+1+v, so the shared sentinel slot vb (edge padding)
+    maps to the two cover sentinels (vb, 2vb+1) and never touches real
+    slots."""
+    sent = vb
+    tri_body = tri_ops.build_window_counter(vb, kb)
+
+    def body(carry, xs):
+        deg, labels, cover = carry
+        src, dst, valid = xs
+        s = jnp.where(valid, src, sent)
+        d = jnp.where(valid, dst, sent)
+        ones = jnp.where(valid, 1, 0)
+
+        deg = deg + (jax.ops.segment_sum(ones, s, vb + 1)
+                     + jax.ops.segment_sum(ones, d, vb + 1))
+        max_degree = jnp.max(deg[:vb])
+
+        labels = unionfind.cc_fixpoint(labels, s, d)
+        touched = deg[:vb] > 0
+        num_components = jnp.sum(
+            touched & (labels[:vb] == jnp.arange(vb)), dtype=jnp.int32)
+
+        cover = unionfind.cc_fixpoint(
+            cover, jnp.concatenate([s, s + (vb + 1)]),
+            jnp.concatenate([d + (vb + 1), d]))
+        odd = jnp.any(touched & (cover[:vb] == cover[vb + 1:2 * vb + 1]))
+
+        tri_count, tri_overflow = tri_body(src, dst, valid)
+
+        return (deg, labels, cover), (
+            max_degree, num_components, odd, tri_count, tri_overflow)
+
+    return body
+
+
+class StreamSummaryEngine:
+    """Carried-state analytics over chunks of windows, one dispatch per
+    MAX_WINDOWS windows. Exact: triangle windows whose hubs overflow K
+    are recounted by the escalating per-window kernel."""
+
+    MAX_WINDOWS = 64
+
+    def __init__(self, edge_bucket: int, vertex_bucket: int,
+                 k_bucket: int = 0):
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self.vb = seg_ops.bucket_size(vertex_bucket)
+        self.kb = seg_ops.bucket_size(k_bucket if k_bucket else
+                                      min(128, 2 * int(np.sqrt(self.eb))))
+        body = _build_scan(self.eb, self.vb, self.kb)
+
+        @jax.jit
+        def run(carry, src_w, dst_w, valid_w):
+            return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
+
+        self._run = run
+        self._tri_fallback = tri_ops.TriangleWindowKernel(
+            edge_bucket=self.eb, vertex_bucket=self.vb,
+            k_bucket=4 * self.kb)
+        self.reset()
+
+    def reset(self) -> None:
+        self._closed_partial = False
+        self._carry = (
+            jnp.zeros(self.vb + 1, jnp.int32),
+            jnp.arange(self.vb + 1, dtype=jnp.int32),
+            jnp.arange(2 * (self.vb + 1), dtype=jnp.int32),
+        )
+
+    def state(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(degrees[vb], cc_labels[vb], odd[vb]) snapshots."""
+        deg, labels, cover = (np.asarray(x) for x in self._carry)
+        odd = cover[: self.vb] == cover[self.vb + 1: 2 * self.vb + 1]
+        return deg[: self.vb], labels[: self.vb], odd
+
+    def process(self, src: np.ndarray, dst: np.ndarray) -> list:
+        """Fold the stream's `edge_bucket`-sized windows; returns one
+        summary dict per window.
+
+        A call whose length is not a multiple of `edge_bucket` CLOSES
+        its partial trailing window (count-based tumbling semantics),
+        so it must be the stream's final call — feed mid-stream chunks
+        in edge_bucket multiples (enforced below)."""
+        src = np.asarray(src, np.int32)
+        dst = np.asarray(dst, np.int32)
+        n = len(src)
+        if n == 0:
+            return []
+        if self._closed_partial:
+            raise ValueError(
+                "a previous process() call closed a partial window "
+                "(length not a multiple of edge_bucket); reset() before "
+                "feeding more of the stream")
+        self._closed_partial = n % self.eb != 0
+        num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
+                                                  sentinel=self.vb)
+        out = []
+        for at in range(0, num_w, self.MAX_WINDOWS):
+            hi = min(at + self.MAX_WINDOWS, num_w)
+            self._carry, (mdeg, ncomp, odd, tri, ovf) = self._run(
+                self._carry, jnp.asarray(s[at:hi]), jnp.asarray(d[at:hi]),
+                jnp.asarray(valid[at:hi]))
+            mdeg, ncomp, odd, tri, ovf = (
+                np.array(x) for x in (mdeg, ncomp, odd, tri, ovf))
+            for w in np.nonzero(ovf)[0]:  # exact redo
+                lo = (at + int(w)) * self.eb
+                tri[w] = self._tri_fallback.count(src[lo:lo + self.eb],
+                                                  dst[lo:lo + self.eb])
+            for w in range(hi - at):
+                out.append({
+                    "max_degree": int(mdeg[w]),
+                    "num_components": int(ncomp[w]),
+                    "odd_cycle": bool(odd[w]),
+                    "triangles": int(tri[w]),
+                })
+        return out

@@ -152,6 +152,23 @@ def segmented_reduce(reduce_fn: Callable, segment_ids: np.ndarray,
     return res, has_any
 
 
+def window_stack(src: np.ndarray, dst: np.ndarray, eb: int,
+                 sentinel: int):
+    """Pad a COO stream to whole `eb`-sized windows and reshape to
+    [W, eb] stacks plus the validity mask — the shared layout of every
+    batched window dispatch (triangles.count_stream, sharded
+    count_stream, scan_analytics.process)."""
+    src = np.asarray(src, np.int32)
+    dst = np.asarray(dst, np.int32)
+    n = len(src)
+    num_w = -(-n // eb)
+    s = pad_to(src, num_w * eb, fill=sentinel).reshape(num_w, eb)
+    d = pad_to(dst, num_w * eb, fill=sentinel).reshape(num_w, eb)
+    valid = pad_to(np.ones(n, bool), num_w * eb,
+                   fill=False).reshape(num_w, eb)
+    return num_w, s, d, valid
+
+
 # ----------------------------------------------------------------------
 # vertex interning (dense ids for device kernels)
 # ----------------------------------------------------------------------

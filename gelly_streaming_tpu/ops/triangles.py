@@ -206,6 +206,49 @@ def csr_positions(a: jax.Array, sent: int, vb: int):
     return idx - seg_first[a]
 
 
+def build_window_counter(vb: int, kb: int):
+    """Pure (unjitted) one-window exact-count body over fixed buckets:
+    run(src[E], dst[E], valid[E]) -> (count, overflow); the edge bucket
+    is whatever shape the caller traces with. Shared by
+    TriangleWindowKernel (jitted / lax.map-wrapped) and the fused
+    analytics scan (ops/scan_analytics.py), which inlines it in a scan
+    body."""
+    sent = vb  # sentinel vertex id: sorts last, row vb is the pad row
+
+    def run(src, dst, valid):
+        # ---- clean: drop self-loops and padding
+        valid = valid & (src != dst)
+        src = jnp.where(valid, src, sent)
+        dst = jnp.where(valid, dst, sent)
+
+        # ---- degrees over the undirected multigraph (for orientation)
+        ones = jnp.where(valid, 1, 0)
+        deg = jax.ops.segment_sum(ones, src, vb + 1)
+        deg = deg + jax.ops.segment_sum(ones, dst, vb + 1)
+
+        # ---- orient low(deg, id) -> high(deg, id)
+        a, b = orient_by_degree(src, dst, deg, sent)
+
+        # ---- sort/dedupe, then CSR column positions within runs
+        a, b = dedupe_pairs(a, b, sent)
+        pos = csr_positions(a, sent, vb)
+        overflow = jnp.sum((pos >= kb) & (a < sent))
+        ok = (a < sent) & (pos < kb)
+        rows = jnp.where(ok, a, vb)
+        cols = jnp.clip(pos, 0, kb - 1)
+        nbr = jnp.full((vb + 1, kb), sent, jnp.int32)
+        nbr = nbr.at[rows, cols].set(
+            jnp.where(ok, b, sent).astype(jnp.int32))
+
+        # ---- neighbor-row intersection at each oriented edge
+        emask = a < sent
+        count = intersect_local(nbr, a.astype(jnp.int32),
+                                b.astype(jnp.int32), emask)
+        return count, overflow
+
+    return run
+
+
 # ----------------------------------------------------------------------
 # streaming fixed-shape engine: the whole window pipeline on device
 # ----------------------------------------------------------------------
@@ -256,42 +299,7 @@ class TriangleWindowKernel:
         self._stream_fns = {}
 
     def _build(self, kb):
-        eb, vb = self.eb, self.vb
-        sent = vb  # sentinel vertex id: sorts last, row vb is the pad row
-
-        @jax.jit
-        def run(src, dst, valid):
-            # ---- clean: drop self-loops and padding
-            valid = valid & (src != dst)
-            src = jnp.where(valid, src, sent)
-            dst = jnp.where(valid, dst, sent)
-
-            # ---- degrees over the undirected multigraph (for orientation)
-            ones = jnp.where(valid, 1, 0)
-            deg = jax.ops.segment_sum(ones, src, vb + 1)
-            deg = deg + jax.ops.segment_sum(ones, dst, vb + 1)
-
-            # ---- orient low(deg, id) -> high(deg, id)
-            a, b = orient_by_degree(src, dst, deg, sent)
-
-            # ---- sort/dedupe, then CSR column positions within runs
-            a, b = dedupe_pairs(a, b, sent)
-            pos = csr_positions(a, sent, vb)
-            overflow = jnp.sum((pos >= kb) & (a < sent))
-            ok = (a < sent) & (pos < kb)
-            rows = jnp.where(ok, a, vb)
-            cols = jnp.clip(pos, 0, kb - 1)
-            nbr = jnp.full((vb + 1, kb), sent, jnp.int32)
-            nbr = nbr.at[rows, cols].set(
-                jnp.where(ok, b, sent).astype(jnp.int32))
-
-            # ---- neighbor-row intersection at each oriented edge
-            emask = a < sent
-            count = intersect_local(nbr, a.astype(jnp.int32),
-                                    b.astype(jnp.int32), emask)
-            return count, overflow
-
-        return run
+        return jax.jit(build_window_counter(self.vb, kb))
 
     def _escalation_ladder(self):
         """K values to try in order: kb, 4·kb, ... up to kb_max."""
@@ -347,16 +355,10 @@ class TriangleWindowKernel:
         so results are always exact."""
         src = np.asarray(src, np.int32)
         dst = np.asarray(dst, np.int32)
-        n = len(src)
-        if n == 0:
+        if len(src) == 0:
             return []
-        num_w = -(-n // self.eb)
-        s = seg_ops.pad_to(src, num_w * self.eb, fill=self.vb)
-        d = seg_ops.pad_to(dst, num_w * self.eb, fill=self.vb)
-        valid = seg_ops.pad_to(np.ones(n, bool), num_w * self.eb, fill=False)
-        s = s.reshape(num_w, self.eb)
-        d = d.reshape(num_w, self.eb)
-        valid = valid.reshape(num_w, self.eb)
+        num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
+                                                  sentinel=self.vb)
         if self.kb not in self._stream_fns:
             self._stream_fns[self.kb] = self._build_stream(self.kb)
         fn = self._stream_fns[self.kb]

@@ -323,17 +323,10 @@ class ShardedTriangleWindowKernel:
 
         src = np.asarray(src, np.int32)
         dst = np.asarray(dst, np.int32)
-        n = len(src)
-        if n == 0:
+        if len(src) == 0:
             return []
-        num_w = -(-n // self.eb)
-        s = seg_ops.pad_to(src, num_w * self.eb, fill=self.vb)
-        d = seg_ops.pad_to(dst, num_w * self.eb, fill=self.vb)
-        valid = seg_ops.pad_to(np.ones(n, bool), num_w * self.eb,
-                               fill=False)
-        s = s.reshape(num_w, self.eb)
-        d = d.reshape(num_w, self.eb)
-        valid = valid.reshape(num_w, self.eb)
+        num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
+                                                  sentinel=self.vb)
         sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
         fn = self._stream_fn(self.kb, self.cap)
         counts: list = []

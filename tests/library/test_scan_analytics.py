@@ -1,0 +1,73 @@
+"""Fused analytics scan = per-window driver analytics, chunk after
+chunk, including triangle hub overflow and carried state."""
+
+import numpy as np
+
+from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+
+
+def test_scan_matches_driver_per_window():
+    rng = np.random.default_rng(17)
+    n, v, eb = 2000, 300, 256
+    src = rng.integers(0, v, n)
+    dst = rng.integers(0, v, n)
+
+    eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=v)
+    # two process() calls: carried state must persist across chunks
+    got = eng.process(src[:1024], dst[:1024]) + eng.process(src[1024:],
+                                                            dst[1024:])
+
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=eb,
+                                   vertex_bucket=v)
+    want = drv.run_arrays(src, dst)
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        nv = len(w.vertex_ids)
+        assert g["triangles"] == w.triangles
+        assert g["max_degree"] == int(w.degrees.max())
+        assert g["odd_cycle"] == bool(w.bipartite_odd[:nv].any())
+        assert g["num_components"] == len(np.unique(w.cc_labels[:nv]))
+
+    deg, labels, odd = eng.state()
+    # driver slots are first-sight order == id order here? not
+    # necessarily: compare degree multiset and final component count
+    assert sorted(deg[deg > 0]) == sorted(
+        want[-1].degrees[want[-1].degrees > 0])
+
+
+def test_scan_triangle_overflow_recounted():
+    eng = StreamSummaryEngine(edge_bucket=1024, vertex_bucket=128,
+                              k_bucket=8)
+    src, dst = [], []
+    for u in range(1, 41):  # 40-clique overflows k=8
+        for v in range(u + 1, 41):
+            src.append(u)
+            dst.append(v)
+    out = eng.process(np.array(src), np.array(dst))
+    from gelly_streaming_tpu.ops import triangles as tri_ops
+
+    assert out[0]["triangles"] == tri_ops.triangle_count_sparse(
+        np.array(src), np.array(dst), 128)
+    assert out[0]["odd_cycle"]  # cliques >= 3 have odd cycles
+
+
+def test_scan_empty_and_reset():
+    eng = StreamSummaryEngine(edge_bucket=64, vertex_bucket=16)
+    assert eng.process(np.array([]), np.array([])) == []
+    out = eng.process(np.array([0, 1]), np.array([1, 2]))
+    assert out[0]["num_components"] == 1
+    eng.reset()
+    deg, labels, odd = eng.state()
+    assert deg.sum() == 0 and not odd.any()
+
+
+def test_scan_partial_window_must_be_final():
+    eng = StreamSummaryEngine(edge_bucket=64, vertex_bucket=16)
+    eng.process(np.array([0, 1, 2]), np.array([1, 2, 3]))  # partial: closes
+    import pytest
+
+    with pytest.raises(ValueError, match="partial window"):
+        eng.process(np.array([4]), np.array([5]))
+    eng.reset()
+    assert eng.process(np.array([4]), np.array([5]))  # fine after reset
