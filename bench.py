@@ -105,14 +105,9 @@ def cpu_reference_window_counts(src, dst, window_edges):
     return counts
 
 
-def main():
-    if "--cpu" in sys.argv:
-        from gelly_streaming_tpu.core.platform import use_cpu
-        use_cpu()
-
+def run_at_scale(scale: float) -> None:
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
-    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
     num_edges = int(2_097_152 * scale)
     window_edges = int(131_072 * scale)
     num_vertices = int(262_144 * scale)
@@ -159,6 +154,28 @@ def main():
         "unit": "edges/s",
         "vs_baseline": round(rate / cpu_rate, 2),
     }))
+
+
+def main():
+    if "--cpu" in sys.argv:
+        from gelly_streaming_tpu.core.platform import use_cpu
+        use_cpu()
+
+    # fall back to smaller streams rather than reporting nothing if the
+    # full-scale run hits a device limit (the metric line names the
+    # actual window size, so a fallback result stays honest)
+    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    for attempt in (scale, scale / 4, scale / 16):
+        try:
+            run_at_scale(attempt)
+            return
+        except AssertionError:
+            raise  # parity failure: NEVER mask a correctness regression
+        except Exception as e:
+            if attempt == scale / 16:
+                raise
+            print("bench failed at scale %g (%s: %s); retrying smaller"
+                  % (attempt, type(e).__name__, e), file=sys.stderr)
 
 
 if __name__ == "__main__":
