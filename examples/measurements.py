@@ -82,9 +82,9 @@ def measure_fused(src, dst, window_edges: int):
             zeros = np.zeros(w * eng.eb, np.int64)
             eng.process(zeros, zeros)
             eng.reset()
-    # the overflow-recount fallback compiles lazily; warm its base rung
-    # so a skewed stream's first hub window doesn't compile mid-timing
-    eng._tri_fallback.count(np.array([0]), np.array([1]))
+    # the overflow-recount fallback compiles lazily; warm it so a
+    # skewed stream's first hub window doesn't compile mid-timing
+    eng.warm_fallback()
     t0 = time.perf_counter()
     results = eng.process(src, dst)
     elapsed = time.perf_counter() - t0

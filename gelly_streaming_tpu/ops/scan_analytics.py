@@ -72,30 +72,15 @@ def _build_scan(eb: int, vb: int, kb: int):
     return body
 
 
-class StreamSummaryEngine:
-    """Carried-state analytics over chunks of windows, one dispatch per
-    MAX_WINDOWS windows. Exact: triangle windows whose hubs overflow K
-    are recounted by the escalating per-window kernel."""
+class SummaryEngineBase:
+    """Shared scaffolding of the single-chip and sharded fused scan
+    engines: carried-state reset/snapshot, the chunk loop, the
+    partial-window-must-be-final guard, and summary assembly.
+    Subclasses provide `_dispatch` (run one [W, eb] chunk, returning
+    the summary tuple with overflow flags last) and `_redo` (exact
+    triangle recount of one overflowing window)."""
 
     MAX_WINDOWS = 64
-
-    def __init__(self, edge_bucket: int, vertex_bucket: int,
-                 k_bucket: int = 0):
-        self.eb = seg_ops.bucket_size(edge_bucket)
-        self.vb = seg_ops.bucket_size(vertex_bucket)
-        self.kb = seg_ops.bucket_size(k_bucket if k_bucket else
-                                      min(128, 2 * int(np.sqrt(self.eb))))
-        body = _build_scan(self.eb, self.vb, self.kb)
-
-        @jax.jit
-        def run(carry, src_w, dst_w, valid_w):
-            return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
-
-        self._run = run
-        self._tri_fallback = tri_ops.TriangleWindowKernel(
-            edge_bucket=self.eb, vertex_bucket=self.vb,
-            k_bucket=4 * self.kb)
-        self.reset()
 
     def reset(self) -> None:
         self._closed_partial = False
@@ -110,6 +95,17 @@ class StreamSummaryEngine:
         deg, labels, cover = (np.asarray(x) for x in self._carry)
         odd = cover[: self.vb] == cover[self.vb + 1: 2 * self.vb + 1]
         return deg[: self.vb], labels[: self.vb], odd
+
+    def _dispatch(self, s, d, valid):
+        raise NotImplementedError
+
+    def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
+        raise NotImplementedError
+
+    def warm_fallback(self) -> None:
+        """Compile the overflow-recount path's base program so a skewed
+        stream's first hub window doesn't compile mid-measurement."""
+        self._redo(np.array([0]), np.array([1]), 1, 1)
 
     def process(self, src: np.ndarray, dst: np.ndarray) -> list:
         """Fold the stream's `edge_bucket`-sized windows; returns one
@@ -135,15 +131,13 @@ class StreamSummaryEngine:
         out = []
         for at in range(0, num_w, self.MAX_WINDOWS):
             hi = min(at + self.MAX_WINDOWS, num_w)
-            self._carry, (mdeg, ncomp, odd, tri, ovf) = self._run(
-                self._carry, jnp.asarray(s[at:hi]), jnp.asarray(d[at:hi]),
-                jnp.asarray(valid[at:hi]))
-            mdeg, ncomp, odd, tri, ovf = (
-                np.array(x) for x in (mdeg, ncomp, odd, tri, ovf))
-            for w in np.nonzero(ovf)[0]:  # exact redo
+            mdeg, ncomp, odd, tri, b_ovf, k_ovf = self._dispatch(
+                s[at:hi], d[at:hi], valid[at:hi])
+            for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
                 lo = (at + int(w)) * self.eb
-                tri[w] = self._tri_fallback.count(src[lo:lo + self.eb],
-                                                  dst[lo:lo + self.eb])
+                tri[w] = self._redo(src[lo:lo + self.eb],
+                                    dst[lo:lo + self.eb],
+                                    int(b_ovf[w]), int(k_ovf[w]))
             for w in range(hi - at):
                 out.append({
                     "max_degree": int(mdeg[w]),
@@ -152,3 +146,40 @@ class StreamSummaryEngine:
                     "triangles": int(tri[w]),
                 })
         return out
+
+
+class StreamSummaryEngine(SummaryEngineBase):
+    """Single-chip carried-state analytics over chunks of windows, one
+    dispatch per MAX_WINDOWS windows. Exact: triangle windows whose
+    hubs overflow K are recounted by the escalating per-window
+    kernel."""
+
+    def __init__(self, edge_bucket: int, vertex_bucket: int,
+                 k_bucket: int = 0):
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self.vb = seg_ops.bucket_size(vertex_bucket)
+        self.kb = seg_ops.bucket_size(k_bucket if k_bucket else
+                                      min(128, 2 * int(np.sqrt(self.eb))))
+        body = _build_scan(self.eb, self.vb, self.kb)
+
+        @jax.jit
+        def run(carry, src_w, dst_w, valid_w):
+            return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
+
+        self._run = run
+        self._tri_fallback = tri_ops.TriangleWindowKernel(
+            edge_bucket=self.eb, vertex_bucket=self.vb,
+            k_bucket=4 * self.kb)
+        self.reset()
+
+    def _dispatch(self, s, d, valid):
+        self._carry, (mdeg, ncomp, odd, tri, ovf) = self._run(
+            self._carry, jnp.asarray(s), jnp.asarray(d),
+            jnp.asarray(valid))
+        # single-chip scan has one overflow signal: report it as k_ovf
+        zeros = np.zeros_like(np.array(ovf))
+        return (*(np.array(x) for x in (mdeg, ncomp, odd, tri)),
+                zeros, np.array(ovf))
+
+    def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
+        return self._tri_fallback.count(src, dst)

@@ -45,10 +45,27 @@ def cc_round(labels: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
 
 
 def cc_fixpoint(labels0: jax.Array, src: jax.Array, dst: jax.Array,
-                exchange=None) -> jax.Array:
+                exchange=None, carried: bool = True) -> jax.Array:
     """Run cc_round + pointer jumping to the fixpoint inside a
     while_loop; `exchange` (e.g. a pmin over the mesh axis) merges
-    labels across shards each round."""
+    labels across shards each round.
+
+    With `carried` (labels0 is a prior forest, not a fresh arange), the
+    forest's parent links (v, labels0[v]) participate as edges in every
+    round. Without them, carried state can SPLIT a component: if an old
+    root simultaneously merges into two different trees in one round
+    (e.g. batch edges (child_of_r, x) with label m1 and (r, y) with
+    label m2 < m1), the scatter-min keeps only the m2 link — the
+    m1-side island stays separate forever, because the evidence
+    connecting it ran through prior batches' edges that are not
+    replayed. The forest edges re-expose exactly that connectivity.
+    Fresh-labeling callers pass carried=False to skip the dead
+    self-loop edges (the flag is trace-time static)."""
+    if carried:
+        fsrc = jnp.arange(labels0.shape[0], dtype=jnp.int32)
+        fdst = labels0.astype(jnp.int32)
+        src = jnp.concatenate([src.astype(jnp.int32), fsrc])
+        dst = jnp.concatenate([dst.astype(jnp.int32), fdst])
 
     def cond(state):
         _, changed = state
@@ -75,7 +92,7 @@ def cc_labels(src: jax.Array, dst: jax.Array, num_vertices: int) -> jax.Array:
     Returns int32 [num_vertices + 1] (last row is the padding sentinel).
     """
     labels0 = jnp.arange(num_vertices + 1, dtype=jnp.int32)
-    return cc_fixpoint(labels0, src, dst)
+    return cc_fixpoint(labels0, src, dst, carried=False)
 
 
 def connected_components(src: np.ndarray, dst: np.ndarray,

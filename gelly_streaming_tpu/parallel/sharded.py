@@ -34,6 +34,7 @@ except ImportError:  # older jax
 
 from .mesh import (SHARD_AXIS, make_mesh, mesh_padded_len,
                    pad_edges_for_mesh, shard_count)
+from ..ops import scan_analytics
 from ..ops import segment as seg_ops
 from ..ops import triangles, unionfind
 
@@ -140,17 +141,25 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
     sacrificed.
     """
     n = shard_count(mesh)
+    step = build_sharded_window_counter(n, eb, vb, kb, cap)
+    return jax.jit(functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
+        out_specs=(P(), P(), P()),
+    )(step))
+
+
+def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
+                                 cap: int, axis: str = SHARD_AXIS):
+    """Pure per-shard one-window body (unwrapped): callable inside any
+    shard_map over `axis` — directly (make_sharded_window_triangle_fn)
+    or within a lax.scan over window stacks (ShardedSummaryEngine)."""
     assert eb % n == 0 and kb % n == 0, (eb, kb, n)
     sent = vb
     kslice = kb // n
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
-        out_specs=(P(), P(), P()),
-    )
     def step(src, dst, valid):
-        me = jax.lax.axis_index(SHARD_AXIS)
+        me = jax.lax.axis_index(axis)
         el = src.shape[0]  # = eb // n
 
         # ---- clean: drop self-loops and padding
@@ -162,7 +171,7 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
         ones = jnp.where(valid, 1, 0)
         local_deg = (jax.ops.segment_sum(ones, s, vb + 1)
                      + jax.ops.segment_sum(ones, d, vb + 1))
-        deg = jax.lax.psum(local_deg, SHARD_AXIS)
+        deg = jax.lax.psum(local_deg, axis)
 
         # ---- orient low(deg, id) -> high(deg, id)
         a, b = triangles.orient_by_degree(s, d, deg, sent)
@@ -190,10 +199,10 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
 
         # ---- collective #2: all_to_all pair exchange over ICI
         recv_a = jax.lax.all_to_all(
-            send_a[:n * cap].reshape(n, cap), SHARD_AXIS,
+            send_a[:n * cap].reshape(n, cap), axis,
             split_axis=0, concat_axis=0, tiled=True).reshape(n * cap)
         recv_b = jax.lax.all_to_all(
-            send_b[:n * cap].reshape(n, cap), SHARD_AXIS,
+            send_b[:n * cap].reshape(n, cap), axis,
             split_axis=0, concat_axis=0, tiled=True).reshape(n * cap)
 
         # ---- local dedupe of owned edges (global dedup by ownership)
@@ -209,19 +218,19 @@ def make_sharded_window_triangle_fn(mesh, eb: int, vb: int, kb: int,
         partial = partial.at[rows, cols].set(jnp.where(ok2, rb, -1))
 
         # ---- collective #3: pmax slice merge -> replicated table
-        nbr = jax.lax.pmax(partial, SHARD_AXIS)
+        nbr = jax.lax.pmax(partial, axis)
         nbr = jnp.where(nbr < 0, sent, nbr)
 
         # ---- each shard intersects the edges it owns; psum the partials
         local = triangles.intersect_local(nbr, ra, rb, ra < sent)
-        count = jax.lax.psum(local, SHARD_AXIS)
+        count = jax.lax.psum(local, axis)
         # separate signals so the host widens only the dimension that
         # overflowed (cap vs K): each (kb, cap) pair is a fresh compile
-        bucket_overflow = jax.lax.psum(bucket_overflow, SHARD_AXIS)
-        k_overflow = jax.lax.psum(k_overflow, SHARD_AXIS)
+        bucket_overflow = jax.lax.psum(bucket_overflow, axis)
+        k_overflow = jax.lax.psum(k_overflow, axis)
         return count, bucket_overflow, k_overflow
 
-    return jax.jit(step)
+    return step
 
 
 class ShardedTriangleWindowKernel:
@@ -454,3 +463,99 @@ class ShardedWindowEngine:
         emask = seg_ops.pad_to(np.asarray(emask, bool), target, fill=False)
         return int(self.tri_fn(jnp.asarray(nbr), jnp.asarray(ea),
                                jnp.asarray(eb), jnp.asarray(emask)))
+
+
+# ----------------------------------------------------------------------
+# sharded fused analytics scan: every analytic, every window, one
+# multi-chip dispatch per chunk (the sharded ops/scan_analytics.py)
+# ----------------------------------------------------------------------
+
+def make_sharded_summary_scan(mesh, eb: int, vb: int, kb: int, cap: int):
+    """shard_map( lax.scan( per-window fused body ) ): the carry
+    (degree vector, CC labels, double-cover labels) is replicated; each
+    window's edges are sharded; all merges ride ICI collectives inside
+    the scan. Cover layout matches ops/scan_analytics.py: (+) = v,
+    (−) = vb+1+v, shared sentinel slot vb."""
+    n = shard_count(mesh)
+    sent = vb
+    tri_body = build_sharded_window_counter(n, eb, vb, kb, cap)
+    pmin_exchange = functools.partial(jax.lax.pmin, axis_name=SHARD_AXIS)
+
+    def body(carry, xs):
+        deg, labels, cover = carry
+        src, dst, valid = xs
+        s = jnp.where(valid, src, sent)
+        d = jnp.where(valid, dst, sent)
+        ones = jnp.where(valid, 1, 0)
+
+        local = (jax.ops.segment_sum(ones, s, vb + 1)
+                 + jax.ops.segment_sum(ones, d, vb + 1))
+        deg = deg + jax.lax.psum(local, SHARD_AXIS)
+        max_degree = jnp.max(deg[:vb])
+
+        labels = unionfind.cc_fixpoint(labels, s, d,
+                                       exchange=pmin_exchange)
+        touched = deg[:vb] > 0
+        num_components = jnp.sum(
+            touched & (labels[:vb] == jnp.arange(vb)), dtype=jnp.int32)
+
+        cover = unionfind.cc_fixpoint(
+            cover, jnp.concatenate([s, s + (vb + 1)]),
+            jnp.concatenate([d + (vb + 1), d]), exchange=pmin_exchange)
+        odd = jnp.any(touched & (cover[:vb] == cover[vb + 1:2 * vb + 1]))
+
+        tri, b_ovf, k_ovf = tri_body(src, dst, valid)
+        return (deg, labels, cover), (
+            max_degree, num_components, odd, tri, b_ovf, k_ovf)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(
+            (P(), P(), P()),                               # carry
+            P(None, SHARD_AXIS), P(None, SHARD_AXIS),      # [W, eb]
+            P(None, SHARD_AXIS),
+        ),
+        out_specs=((P(), P(), P()),
+                   (P(), P(), P(), P(), P(), P())),
+    )
+    def run(carry, src_w, dst_w, valid_w):
+        return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
+
+    return jax.jit(run)
+
+
+class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
+    """Multi-chip StreamSummaryEngine (ops/scan_analytics.py): carried-
+    state fused analytics over [W, eb] window stacks with the edge axis
+    sharded over the mesh — one dispatch per MAX_WINDOWS windows.
+    Triangle windows that overflow K or the exchange capacity are
+    recounted exactly by the escalating per-window sharded kernel."""
+
+    def __init__(self, mesh, edge_bucket: int, vertex_bucket: int,
+                 k_bucket: int = 0):
+        self.mesh = mesh
+        self._tri = ShardedTriangleWindowKernel(
+            mesh, edge_bucket=edge_bucket, vertex_bucket=vertex_bucket,
+            k_bucket=k_bucket)
+        self.eb = self._tri.eb
+        self.vb = self._tri.vb
+        self._run = make_sharded_summary_scan(
+            mesh, self.eb, self.vb, self._tri.kb, self._tri.cap)
+        self.reset()
+
+    def _dispatch(self, s, d, valid):
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+        self._carry, res = self._run(
+            self._carry,
+            jax.device_put(s, sharding),
+            jax.device_put(d, sharding),
+            jax.device_put(valid, sharding))
+        return tuple(np.array(x) for x in res)
+
+    def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
+        return self._tri.count(
+            src, dst,
+            failed_kb=self._tri.kb if k_ovf else 0,
+            failed_cap=self._tri.cap if b_ovf else 0)

@@ -106,3 +106,19 @@ def test_carried_labels_merge_through_non_root_members():
     out = unionfind.connected_components_with_labels(
         np.array([5]), np.array([6]), labels, 8)
     assert list(out[[0, 1, 5, 6]]) == [0, 0, 0, 0]
+
+
+def test_carried_labels_concurrent_merge_island_split():
+    """Regression: an old root merging into TWO trees in one round must
+    not strand the larger-label island. Carried forest {3:root,4:child}
+    and {1:root,5:child}; batch edges (4,1) and (3,0): without forest
+    links in the rounds, {1,4,5} keeps label 1 while 3 joins 0 —
+    splitting one true component (ops/unionfind.cc_fixpoint)."""
+    import numpy as np
+
+    from gelly_streaming_tpu.ops import unionfind
+
+    labels = np.array([0, 1, 2, 3, 3, 1], np.int32)
+    out = unionfind.connected_components_with_labels(
+        np.array([4, 3]), np.array([1, 0]), labels, 6)
+    assert list(out[[0, 1, 3, 4, 5]]) == [0, 0, 0, 0, 0]

@@ -195,3 +195,38 @@ def test_hybrid_mesh_single_process_shapes():
     assert k.count(src, dst) == tri_ops.triangle_count_sparse(src, dst, 64)
     with pytest.raises(ValueError, match="devices"):
         multihost.make_hybrid_mesh(ici_shards=3, dcn_shards=2)
+
+
+def test_sharded_summary_engine_matches_single_chip():
+    """Sharded fused scan = single-chip fused scan, carried state
+    across chunks, including a hub-overflow window."""
+    from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+    from gelly_streaming_tpu.parallel.sharded import ShardedSummaryEngine
+
+    rng = np.random.default_rng(23)
+    n, v, eb = 2048, 200, 256
+    src = rng.integers(0, v, n)
+    dst = rng.integers(0, v, n)
+    # splice a 30-clique into window 3 to force a K overflow
+    cl_s, cl_d = [], []
+    for u in range(1, 31):
+        for w in range(u + 1, 31):
+            cl_s.append(u)
+            cl_d.append(w)
+    src[3 * eb:3 * eb + len(cl_s[:eb])] = cl_s[:eb]
+    dst[3 * eb:3 * eb + len(cl_d[:eb])] = cl_d[:eb]
+
+    sh = ShardedSummaryEngine(make_mesh(), edge_bucket=eb,
+                              vertex_bucket=v, k_bucket=8)
+    single = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=v,
+                                 k_bucket=8)
+    got = sh.process(src[:1024], dst[:1024]) + sh.process(src[1024:],
+                                                          dst[1024:])
+    want = single.process(src[:1024], dst[:1024]) + single.process(
+        src[1024:], dst[1024:])
+    assert got == want
+    sd, sl, so = sh.state()
+    wd, wl, wo = single.state()
+    np.testing.assert_array_equal(sd[:v], wd[:v])
+    np.testing.assert_array_equal(sl[:v], wl[:v])
+    np.testing.assert_array_equal(so[:v], wo[:v])
