@@ -346,6 +346,27 @@ class TriangleWindowKernel:
 
         return run_stream
 
+    def _run_stack(self, s, d, valid, get_window) -> list:
+        """Dispatch a [W, eb] window stack in MAX_STREAM_WINDOWS chunks;
+        `get_window(w)` returns the raw (src, dst) of window w for the
+        rare exact overflow recount."""
+        if self.kb not in self._stream_fns:
+            self._stream_fns[self.kb] = self._build_stream(self.kb)
+        fn = self._stream_fns[self.kb]
+        num_w = s.shape[0]
+        counts: list = []
+        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
+            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
+            c, o = fn(jnp.asarray(s[at:hi]), jnp.asarray(d[at:hi]),
+                      jnp.asarray(valid[at:hi]))
+            # np.array (not asarray): device outputs can be read-only
+            c, o = np.array(c), np.array(o)
+            for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
+                ws, wd = get_window(at + int(w))
+                c[w] = self.count(ws, wd, min_k=self.kb)
+            counts.extend(int(x) for x in c)
+        return counts
+
     def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
         """Exact counts of every tumbling `edge_bucket`-sized window of
         the stream, batched into one device program per
@@ -359,23 +380,31 @@ class TriangleWindowKernel:
             return []
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
                                                   sentinel=self.vb)
-        if self.kb not in self._stream_fns:
-            self._stream_fns[self.kb] = self._build_stream(self.kb)
-        fn = self._stream_fns[self.kb]
-        counts: list = []
-        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
-            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            c, o = fn(jnp.asarray(s[at:hi]), jnp.asarray(d[at:hi]),
-                      jnp.asarray(valid[at:hi]))
-            # np.array (not asarray): device outputs can be read-only
-            c, o = np.array(c), np.array(o)
-            for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
-                lo_e = (at + int(w)) * self.eb
-                c[w] = self.count(src[lo_e:lo_e + self.eb],
-                                  dst[lo_e:lo_e + self.eb],
-                                  min_k=self.kb)
-            counts.extend(int(x) for x in c)
-        return counts
+        eb = self.eb
+        return self._run_stack(
+            s, d, valid,
+            lambda w: (src[w * eb:(w + 1) * eb], dst[w * eb:(w + 1) * eb]))
+
+    def count_windows(self, windows) -> list:
+        """Exact counts of a list of (src, dst) window batches of
+        varying lengths (each ≤ edge_bucket), padded into one stack and
+        dispatched in chunks — the batched form of calling count() per
+        window (used by the driver's event-time windows)."""
+        if not windows:
+            return []
+        num_w = len(windows)
+        s = np.full((num_w, self.eb), self.vb, np.int32)
+        d = np.full((num_w, self.eb), self.vb, np.int32)
+        valid = np.zeros((num_w, self.eb), bool)
+        for w, (ws, wd) in enumerate(windows):
+            n = len(ws)
+            if n > self.eb:
+                raise ValueError(f"window of {n} edges exceeds edge "
+                                 f"bucket {self.eb}")
+            s[w, :n] = ws
+            d[w, :n] = wd
+            valid[w, :n] = True
+        return self._run_stack(s, d, valid, lambda w: windows[w])
 
 
 def triangle_count(src: np.ndarray, dst: np.ndarray, num_vertices: int) -> int:
