@@ -78,6 +78,7 @@ class StreamingAnalyticsDriver:
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.eb = seg_ops.bucket_size(edge_bucket)
         self._degrees = np.zeros(0, np.int64)
+        self._deg_state = None    # device-carried degrees (single-chip)
         self._cc = np.zeros(0, np.int32)
         self._bip = np.zeros(0, np.int32)
         self._tri_kernel = None
@@ -85,8 +86,25 @@ class StreamingAnalyticsDriver:
         self._sh_tri = None       # sharded: ShardedTriangleWindowKernel
         self.windows_done = 0     # survives checkpoints: resume cursor
         self.edges_done = 0       # count-based window_start offset
+        self._closed_partial = False  # count-based misuse guard
         self._ckpt_path = None
         self._ckpt_every = 0
+
+    def reset(self) -> None:
+        """Clear all carried stream state (interner, analytics vectors,
+        cursors) while keeping every compiled kernel, so warmup windows
+        can be discarded without polluting a measured run."""
+        self.interner = make_interner(np.array([0]))
+        self._ext_ids = np.zeros(0, np.int64)
+        self._degrees = np.zeros(0, np.int64)
+        self._deg_state = None
+        self._cc = np.zeros(0, np.int32)
+        self._bip = np.zeros(0, np.int32)
+        self.windows_done = 0
+        self.edges_done = 0
+        self._closed_partial = False
+        if self._engine is not None:
+            self._engine.reset()
 
     # ------------------------------------------------------------------
     # bucket growth (O(log V) recompiles over an unbounded stream)
@@ -234,6 +252,17 @@ class StreamingAnalyticsDriver:
         # count-based: window_start = absolute stream offset; the
         # edges_done cursor advances per window (inside _window, so
         # checkpoints carry it), making chunked calls accumulate
+        if self._closed_partial:
+            # same guard as scan_analytics.SummaryEngineBase.process:
+            # a previous call already closed a short window, so feeding
+            # more edges would silently shift every subsequent window
+            # boundary relative to a single whole-stream call
+            raise ValueError(
+                "a previous count-based run closed a partial window "
+                "(length not a multiple of edge_bucket); chunked "
+                "count-based feeding must use edge_bucket multiples")
+        if len(src):
+            self._closed_partial = len(src) % self.eb != 0
         out = []
         for i in range(0, len(src), self.eb):
             idx = slice(i, min(i + self.eb, len(src)))
@@ -286,14 +315,28 @@ class StreamingAnalyticsDriver:
             if sharded:
                 res.degrees = np.array(self._engine.degrees(s, d)[:nv])
             else:
-                counts = (np.bincount(s, minlength=nv)
-                          + np.bincount(d, minlength=nv)).astype(np.int64)
-                if len(self._degrees) < nv:
-                    self._degrees = np.concatenate([
-                        self._degrees,
-                        np.zeros(nv - len(self._degrees), np.int64)])
-                self._degrees += counts
-                res.degrees = self._degrees.copy()
+                import jax.numpy as jnp
+
+                # carried device state (length vb+1; slot vb is the
+                # padding sentinel), lazily (re)built from the host
+                # mirror after construction, reset, resume, or growth.
+                # int32 on device — the same width the sharded engine
+                # carries; snapshots widen back to the int64 contract.
+                if (self._deg_state is None
+                        or len(self._deg_state) != self.vb + 1):
+                    st = np.zeros(self.vb + 1, np.int32)
+                    st[:len(self._degrees)] = self._degrees
+                    self._deg_state = jnp.asarray(st)
+                nb = seg_ops.bucket_size(len(s))
+                sp = seg_ops.pad_to(np.asarray(s, np.int32), nb,
+                                    fill=self.vb)
+                dp = seg_ops.pad_to(np.asarray(d, np.int32), nb,
+                                    fill=self.vb)
+                self._deg_state = seg_ops.degree_update(
+                    self._deg_state, jnp.asarray(sp), jnp.asarray(dp))
+                snap = np.asarray(self._deg_state[:nv]).astype(np.int64)
+                self._degrees = snap  # host mirror: checkpoint source
+                res.degrees = snap.copy()
         elif name == "cc":
             if sharded:
                 res.cc_labels = np.array(self._engine.cc_labels(s, d)[:nv])
@@ -364,6 +407,8 @@ class StreamingAnalyticsDriver:
             "windows_done": self.windows_done,
             "edges_done": self.edges_done,
             "edge_bucket": self.eb,
+            "vertex_bucket": self.vb,
+            "closed_partial": self._closed_partial,
             "vertex_ids": np.array(self._vertex_ids(len(self.interner))),
             "degrees": self._degrees.copy(),
             "cc": self._cc.copy(),
@@ -393,14 +438,30 @@ class StreamingAnalyticsDriver:
         self._ext_ids = np.zeros(0, np.int64)
         self.windows_done = int(state.get("windows_done", 0))
         self.edges_done = int(state.get("edges_done", 0))
+        # persist the misuse guard: a checkpoint taken after a partial
+        # count-based window must refuse further unaligned feeding just
+        # like the live driver would
+        self._closed_partial = bool(state.get("closed_partial", False))
         if "edge_bucket" in state:
             # count-based windowing is governed by eb exactly as event
             # time is by window_ms: restore it so resumed streams cut
             # the same windows the checkpointed run would have
             self.eb = int(state["edge_bucket"])
+        if "vertex_bucket" in state:
+            # adopt the checkpointed capacity up front (it can only have
+            # grown past the constructor default); without this a
+            # sharded resume built with a different vertex_bucket dies
+            # deep in ShardedWindowEngine.load_state_dict with a
+            # 'vertex bucket mismatch' that never names the parameter
+            self.vb = int(state["vertex_bucket"])
+            # force rebuild of everything compiled at the old capacity
+            self._engine = None
+            self._tri_kernel = None
+            self._sh_tri = None
         self.interner.intern_array(np.asarray(state["vertex_ids"],
                                               np.int64))
         self._degrees = np.array(state["degrees"])
+        self._deg_state = None  # rebuilt from the mirror on next window
         self._cc = np.array(state["cc"])
         self._bip = np.array(state["bip"])
         self._ensure_buckets(len(state["vertex_ids"]), 1)

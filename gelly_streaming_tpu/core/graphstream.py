@@ -181,7 +181,7 @@ class SimpleEdgeStream(GraphStream):
             counts[vertex.id] = counts.get(vertex.id, 0) + vertex.value
             return Vertex(vertex.id, counts[vertex.id])
 
-        return self.edges.flat_map(separator).key_by(0).map(running_count)
+        return self.aggregate(separator, running_count)
 
     def get_degrees(self) -> DataStream:
         return self._degree_stream(True, True)
@@ -238,9 +238,23 @@ class SimpleEdgeStream(GraphStream):
     # ------------------------------------------------------------------
     # aggregation & discretization
     # ------------------------------------------------------------------
-    def aggregate(self, graph_aggregation) -> DataStream:
-        """Run a summary aggregation (reference: SimpleEdgeStream.java:104-106)."""
-        return graph_aggregation.run(self.get_edges())
+    def aggregate(self, graph_aggregation_or_edge_mapper,
+                  vertex_mapper=None) -> DataStream:
+        """Two overloads, matching the reference's:
+
+        aggregate(graph_aggregation) — run a summary aggregation
+        (reference: SimpleEdgeStream.java:104-106).
+
+        aggregate(edge_mapper, vertex_mapper) — the generic continuous
+        aggregate: flat-map each edge into keyed vertex records, then
+        apply a stateful per-key map emitting one improving update per
+        input (reference: SimpleEdgeStream.java:493-498; basis of the
+        degree streams, :417-498).
+        """
+        if vertex_mapper is None:
+            return graph_aggregation_or_edge_mapper.run(self.get_edges())
+        return (self.edges.flat_map(graph_aggregation_or_edge_mapper)
+                .key_by(0).map(vertex_mapper))
 
     def slice(self, size: Time,
               direction: EdgeDirection = EdgeDirection.OUT) -> "GraphWindowStream":

@@ -225,11 +225,14 @@ def _device_apply_kernel(apply_udf: JaxEdgesApply):
         np.cumsum(counts, out=starts[1:])
         dst_sorted = np.asarray(dst)[order]
         val_sorted = val[order]
-        for i in range(n_seg):
-            c = counts[i]
-            nbr[i, :c] = dst_sorted[starts[i]:starts[i] + c]
-            vals[i, :c] = val_sorted[starts[i]:starts[i] + c]
-            mask[i, :c] = True
+        # vectorized CSR fill: each sorted edge's slot is its rank
+        # within its segment (one O(E) scatter, no per-vertex loop —
+        # same idiom as the sharded neighbor-table build,
+        # parallel/sharded.py)
+        rank = np.arange(len(s_sorted)) - starts[s_sorted]
+        nbr[s_sorted, rank] = dst_sorted
+        vals[s_sorted, rank] = val_sorted
+        mask[s_sorted, rank] = True
         res = vmapped(jnp.asarray(np.asarray(uniq)), jnp.asarray(nbr),
                       jnp.asarray(vals), jnp.asarray(mask))
         leaves = [np.asarray(l) for l in jax.tree_util.tree_leaves(res)]

@@ -63,6 +63,16 @@ def segment_reduce(values: jax.Array, segment_ids: jax.Array, num_segments: int,
     raise ValueError(kind)
 
 
+@jax.jit
+def degree_update(state: jax.Array, src: jax.Array,
+                  dst: jax.Array) -> jax.Array:
+    """Fold one window batch into a running degree vector on device
+    (continuous-degree semantics of SimpleEdgeStream.java:465-482,
+    emitted per window). src/dst are padded with sentinel id
+    len(state)-1, whose slot absorbs the padding contributions."""
+    return state.at[src].add(1).at[dst].add(1)
+
+
 # ----------------------------------------------------------------------
 # generic segmented fold (sequential within segment, parallel-free scan)
 # ----------------------------------------------------------------------

@@ -76,6 +76,16 @@ def make_hybrid_mesh(ici_shards: Optional[int] = None,
             (ici_shards,), (dcn_shards,), devices=devices,
             process_is_granule=True)
         arr = np.asarray(arr).reshape(dcn_shards, ici_shards)
+        # the reshape assumes granule-major flat ordering; if
+        # mesh_utils ever lays the array out differently, ICI neighbors
+        # would silently land across DCN — fail loudly instead
+        for row in arr:
+            procs = {d.process_index for d in row}
+            if len(procs) != 1:
+                raise RuntimeError(
+                    "hybrid mesh layout mismatch: ICI row spans "
+                    f"processes {sorted(procs)}; expected one process "
+                    "per DCN granule (granule-major ordering)")
     else:  # single process: any contiguity works, DCN axis is logical
         arr = np.asarray(devices).reshape(dcn_shards, ici_shards)
     return Mesh(arr, (DCN_AXIS, SHARD_AXIS))

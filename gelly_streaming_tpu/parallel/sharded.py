@@ -380,6 +380,14 @@ class ShardedWindowEngine:
         self._bip_fn = None
         self._bip_labels = None
 
+    def reset(self) -> None:
+        """Clear carried analytics state; compiled programs are kept, so
+        a reset engine re-streams with zero recompilation (used by
+        measurement warmup)."""
+        self._degree_state = jnp.zeros(self.vb + 2, jnp.int32)
+        self._labels = jnp.arange(self.vb + 2, dtype=jnp.int32)
+        self._bip_labels = None
+
     def _prep(self, src, dst):
         src, dst = pad_edges_for_mesh(
             np.asarray(src, np.int32), np.asarray(dst, np.int32),

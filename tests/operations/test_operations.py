@@ -165,3 +165,24 @@ def test_union(env):
     assert run_and_sort(env, first.union(second).get_edges()) == sorted(
         ["1,2,12", "1,3,13", "2,3,23", "3,4,34", "3,5,35", "4,5,45", "5,1,51"]
     )
+
+
+def test_public_aggregate(env):
+    # reference: SimpleEdgeStream.java:493-498 — the generic
+    # flatMap -> keyBy(0) -> stateful map composition, here computing a
+    # running sum of edge values per source vertex
+    from gelly_streaming_tpu import Vertex
+
+    def edge_value_per_source(edge, collect):
+        collect(Vertex(edge.source, edge.value))
+
+    sums = {}
+
+    def running_sum(vertex):
+        sums[vertex.id] = sums.get(vertex.id, 0) + vertex.value
+        return Vertex(vertex.id, sums[vertex.id])
+
+    out = _graph(env).aggregate(edge_value_per_source, running_sum)
+    assert run_and_sort(env, out) == sorted(
+        ["1,12", "1,25", "2,23", "3,34", "3,69", "4,45", "5,51"]
+    )

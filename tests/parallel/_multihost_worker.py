@@ -1,0 +1,75 @@
+"""Worker for the 2-process CPU jax.distributed smoke test.
+
+Launched (twice) by test_sharded.py::test_multihost_two_process_smoke.
+Executes the multi-process branches of parallel/multihost.py that a
+single-process test can never reach: initialize_runtime,
+make_hybrid_mesh(process_is_granule=True) with the granule-contiguity
+check, and one sharded degree window over the flattened hybrid mesh
+(the DCN-crossing psum of SURVEY.md §5.8).
+
+Usage: _multihost_worker.py <process_id> <num_processes> <port>
+Prints "MULTIHOST_OK <process_id>" on success.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=4").strip()
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def main() -> None:
+    proc_id, nprocs, port = (int(sys.argv[1]), int(sys.argv[2]),
+                             sys.argv[3])
+    from gelly_streaming_tpu.parallel.multihost import (
+        flatten_for_edges, initialize_runtime, make_hybrid_mesh)
+
+    initialize_runtime(coordinator_address=f"localhost:{port}",
+                       num_processes=nprocs, process_id=proc_id)
+    import jax
+    import numpy as np
+
+    assert jax.process_count() == nprocs, jax.process_count()
+    assert jax.device_count() == 4 * nprocs, jax.device_count()
+
+    mesh = make_hybrid_mesh()  # defaults: one DCN granule per process
+    assert mesh.shape == {"dcn": nprocs, "shard": 4}, mesh.shape
+    flat = flatten_for_edges(mesh)
+
+    from gelly_streaming_tpu.parallel.sharded import (
+        make_sharded_degree_fn)
+    from gelly_streaming_tpu.parallel.mesh import pad_edges_for_mesh
+
+    vb = 16
+    degree_fn = make_sharded_degree_fn(flat, vb)
+    # one window: a ring over vertices 0..9 — every vertex degree 2
+    src = np.arange(10, dtype=np.int32)
+    dst = ((np.arange(10) + 1) % 10).astype(np.int32)
+    s, d = pad_edges_for_mesh(src, dst, flat, sentinel=vb + 1)
+
+    # every process holds the whole window; lift host copies into
+    # GLOBAL arrays spanning both processes' devices (the multi-host
+    # ingestion contract: addressable shards are filled from local data)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def global_array(host, spec):
+        sharding = NamedSharding(flat, spec)
+        return jax.make_array_from_callback(
+            host.shape, sharding, lambda idx: host[idx])
+
+    zeros = np.zeros(vb + 2, np.int32)
+    counts = degree_fn(global_array(s, P("shard")),
+                       global_array(d, P("shard")),
+                       global_array(zeros, P()))
+    # out_spec P() → fully replicated: every process reads the result
+    got = np.asarray(counts)[:10]
+    np.testing.assert_array_equal(got, np.full(10, 2))
+    print(f"MULTIHOST_OK {proc_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -244,17 +244,67 @@ def test_sharded_bucket_growth_carries_engine_state():
     read-only state_dict views + remap correctness)."""
     drv = StreamingAnalyticsDriver(window_ms=0, mesh=make_mesh(),
                                    vertex_bucket=8, edge_bucket=16)
-    # window 1: vertices 0..9 (grows 8→16 before engine exists is
-    # avoided by keeping nv <= 8 here)
-    drv.run_arrays(np.arange(4), np.arange(4) + 4)          # nv = 8
+    # window 1: a full 16-edge bucket over vertices 0..7 only, so the
+    # engine is built at vb=8 before any growth
+    s1 = np.tile(np.arange(4), 4)
+    d1 = s1 + 4                                              # nv = 8
     # window 2: new vertices force growth with live engine state
-    out = drv.run_arrays(np.arange(20), np.arange(20) + 20)  # nv = 40
+    s2, d2 = np.arange(16), np.arange(16) + 16               # nv = 32
+    drv.run_arrays(s1, d1)
+    out = drv.run_arrays(s2, d2)
     single = StreamingAnalyticsDriver(window_ms=0, vertex_bucket=8,
                                       edge_bucket=16)
-    single.run_arrays(np.arange(4), np.arange(4) + 4)
-    want = single.run_arrays(np.arange(20), np.arange(20) + 20)
-    np.testing.assert_array_equal(out[-1].degrees[:40],
-                                  want[-1].degrees[:40])
-    np.testing.assert_array_equal(out[-1].bipartite_odd[:40],
-                                  want[-1].bipartite_odd[:40])
+    single.run_arrays(s1, d1)
+    want = single.run_arrays(s2, d2)
+    np.testing.assert_array_equal(out[-1].degrees[:32],
+                                  want[-1].degrees[:32])
+    np.testing.assert_array_equal(out[-1].bipartite_odd[:32],
+                                  want[-1].bipartite_odd[:32])
     assert out[-1].triangles == want[-1].triangles
+
+
+def test_driver_count_based_partial_window_guard():
+    # ADVICE r1: a chunked count-based feed whose chunk is not an
+    # edge_bucket multiple closes a short window and would silently
+    # shift every later boundary — the driver must refuse more input
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=8,
+                                   analytics=("degrees",))
+    src = np.arange(12) % 5
+    drv.run_arrays(src, (src + 1) % 5)  # closes an 8 + partial-4 window
+    with pytest.raises(ValueError, match="partial window"):
+        drv.run_arrays(src[:8], src[:8])
+    drv.reset()
+    drv.run_arrays(src[:8], (src[:8] + 1) % 5)  # multiples stay fine
+    drv.run_arrays(src[:8], (src[:8] + 1) % 5)
+
+
+def test_driver_reset_gives_clean_rerun():
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=8,
+                                   analytics=("degrees", "cc"))
+    src = np.arange(16) % 7
+    dst = (src + 2) % 7
+    first = drv.run_arrays(src, dst)
+    drv.reset()
+    assert drv.windows_done == 0 and drv.edges_done == 0
+    again = drv.run_arrays(src, dst)
+    np.testing.assert_array_equal(first[-1].degrees, again[-1].degrees)
+    np.testing.assert_array_equal(first[-1].cc_labels, again[-1].cc_labels)
+
+
+def test_driver_checkpoint_carries_vertex_bucket(tmp_path):
+    # ADVICE r1: resume must adopt the checkpointed vertex bucket up
+    # front instead of dying deep in the engine with a mismatch error
+    p = str(tmp_path / "ck.npz")
+    a = StreamingAnalyticsDriver(window_ms=0, vertex_bucket=16,
+                                 edge_bucket=8, analytics=("degrees",))
+    src = np.arange(64) % 40  # grows the vertex bucket past 16
+    a.run_arrays(src, (src + 3) % 40)
+    import gelly_streaming_tpu.utils.checkpoint as ckpt
+    ckpt.save(p, a.state_dict())
+    b = StreamingAnalyticsDriver(window_ms=0, vertex_bucket=1 << 12,
+                                 edge_bucket=8, analytics=("degrees",))
+    assert b.try_resume(p)
+    assert b.vb == a.vb
+    ra = a.run_arrays(src[:8], (src[:8] + 3) % 40)
+    rb = b.run_arrays(src[:8], (src[:8] + 3) % 40)
+    np.testing.assert_array_equal(ra[-1].degrees, rb[-1].degrees)
