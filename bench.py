@@ -187,8 +187,15 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
     num_edges = int(2_097_152 * scale)
-    window_edges = int(131_072 * scale)
-    num_vertices = int(262_144 * scale)
+    # The window is CAPPED at 32768 edges: scaling up grows the STREAM
+    # (more windows through the same compiled program — the north-star
+    # metric is edges/sec over a 10M-edge stream slice), not the window.
+    # An uncapped 131072-edge window program sent the tunnel's remote
+    # compile into a >30min stall in round 2; the per-edge triangle work
+    # also grows superlinearly with window length, so bigger windows
+    # would only make the reported rate conservative, not comparable.
+    window_edges = min(int(131_072 * scale), 32_768)
+    num_vertices = min(int(262_144 * scale), 65_536)
     src, dst = make_stream(num_edges, num_vertices)
 
     kernel = TriangleWindowKernel(
@@ -232,6 +239,11 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
         "value": round(rate),
         "unit": "edges/s",
         "vs_baseline": round(rate / cpu_rate, 2),
+        # the measured baseline itself, persisted (BASELINE.md milestone:
+        # faithful CPU port of WindowTriangles.java:83-140 on the same
+        # stream; the reference publishes no numbers of its own)
+        "baseline_cpu_edges_per_s": round(cpu_rate),
+        "num_edges": num_edges,
     }), flush=True)
 
 
@@ -269,9 +281,11 @@ def main():
     # external timeout at a larger scale still leaves the best completed
     # number on stdout (the driver keeps the last line). Every requested
     # scale is attempted on every backend.
-    scale = float(os.environ.get("BENCH_SCALE", "1.0"))
+    # top scale = a 10.5M-edge stream (≥ the north star's 10M-edge
+    # slice) through the capped 32768-edge window program
+    scale = float(os.environ.get("BENCH_SCALE", "5.0"))
     done = 0
-    for attempt in (scale / 16, scale / 4, scale):
+    for attempt in (scale / 80, scale / 20, scale):
         try:
             run_at_scale(attempt, metric_suffix)
             done += 1

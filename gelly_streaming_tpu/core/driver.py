@@ -140,16 +140,14 @@ class StreamingAnalyticsDriver:
                     lab[:n_deg] = st["labels"][:-2]
                     new["labels"] = lab
                     if "bip_labels" in st:
-                        bl = np.arange(2 * self.vb + 2, dtype=np.int32)
-                        old_vb = n_deg
-                        old_bl = st["bip_labels"]
-                        # remap cover slots (v, v+old_vb) → (v, v+vb)
-                        shift = self.vb - old_vb
-                        remap = np.where(old_bl[:-2] >= old_vb,
-                                         old_bl[:-2] + shift, old_bl[:-2])
-                        bl[:old_vb] = remap[:old_vb]
-                        bl[self.vb:self.vb + old_vb] = remap[old_vb:]
-                        new["bip_labels"] = bl
+                        # same cover re-layout as the single-chip path,
+                        # plus the engine's two trailing sentinel slots
+                        new["bip_labels"] = np.concatenate([
+                            self._grow_cover(
+                                np.asarray(st["bip_labels"][:-2]),
+                                self.vb),
+                            np.arange(2 * self.vb, 2 * self.vb + 2,
+                                      dtype=np.int32)])
                     self._engine.load_state_dict(new)
             if "triangles" in self.analytics:
                 self._sh_tri = ShardedTriangleWindowKernel(
@@ -261,11 +259,16 @@ class StreamingAnalyticsDriver:
                 "a previous count-based run closed a partial window "
                 "(length not a multiple of edge_bucket); chunked "
                 "count-based feeding must use edge_bucket multiples")
-        if len(src):
-            self._closed_partial = len(src) % self.eb != 0
         out = []
         for i in range(0, len(src), self.eb):
             idx = slice(i, min(i + self.eb, len(src)))
+            if idx.stop - idx.start < self.eb:
+                # set ONLY when the short final window is actually being
+                # emitted, so a checkpoint taken by an earlier window of
+                # this call (or a crash before this point) never
+                # persists a closed_partial the restored state hasn't
+                # seen — the flag lands in this window's own checkpoint
+                self._closed_partial = True
             out.append(self._window(self.edges_done, src[idx], dst[idx]))
         return out
 
@@ -308,12 +311,40 @@ class StreamingAnalyticsDriver:
                 checkpoint.save(self._ckpt_path, self.state_dict())
         return res
 
+    @staticmethod
+    def _check_degree_width(snap: np.ndarray) -> None:
+        """Device degree state is int32 (TPUs run 32-bit; x64 is a
+        global jax switch). A window adds < 2^32 endpoint counts, so a
+        vertex crossing 2^31 shows up negative at the very next
+        snapshot — fail loudly there instead of persisting a wrapped
+        count into checkpoints."""
+        if len(snap) and int(snap.min()) < 0:
+            raise OverflowError(
+                "a vertex's running degree crossed 2^31 (int32 device "
+                "state); shard the stream or reset windows before any "
+                "single vertex accumulates that many incident edges")
+
+    @staticmethod
+    def _grow_cover(old: np.ndarray, vb: int) -> np.ndarray:
+        """Re-lay a double-cover labeling out over a wider vertex
+        bucket: (−) slots move from old_vb+v to vb+v, labels pointing
+        into the (−) half shift with them, new slots are identity."""
+        old_vb = len(old) // 2
+        cover = np.arange(2 * vb, dtype=np.int32)
+        if old_vb:
+            shifted = np.where(old >= old_vb, old + (vb - old_vb),
+                               old).astype(np.int32)
+            cover[:old_vb] = shifted[:old_vb]
+            cover[vb:vb + old_vb] = shifted[old_vb:]
+        return cover
+
     def _run_one(self, name: str, s: np.ndarray, d: np.ndarray,
                  nv: int, res: WindowResult) -> None:
         sharded = self._engine is not None
         if name == "degrees":
             if sharded:
                 res.degrees = np.array(self._engine.degrees(s, d)[:nv])
+                self._check_degree_width(res.degrees)
             else:
                 import jax.numpy as jnp
 
@@ -334,7 +365,11 @@ class StreamingAnalyticsDriver:
                                     fill=self.vb)
                 self._deg_state = seg_ops.degree_update(
                     self._deg_state, jnp.asarray(sp), jnp.asarray(dp))
-                snap = np.asarray(self._deg_state[:nv]).astype(np.int64)
+                # slice on the HOST: jnp's [:nv] would trace a fresh
+                # dynamic_slice program for every distinct vertex count
+                # (one recompile per window on a growing stream)
+                snap = np.asarray(self._deg_state)[:nv].astype(np.int64)
+                self._check_degree_width(snap)
                 self._degrees = snap  # host mirror: checkpoint source
                 res.degrees = snap.copy()
         elif name == "cc":
@@ -346,28 +381,26 @@ class StreamingAnalyticsDriver:
                         self._cc,
                         np.arange(len(self._cc), nv, dtype=np.int32)])
                 self._cc = unionfind.connected_components_with_labels(
-                    s, d, self._cc, nv)
+                    s, d, self._cc, nv, vertex_bucket=self.vb)
                 res.cc_labels = self._cc.copy()
         elif name == "bipartite":
             if sharded:
                 _, _, odd = self._engine.bipartite(s, d)
                 res.bipartite_odd = np.array(odd[:nv])
             else:
-                if len(self._bip) < 2 * nv:
-                    prev = len(self._bip) // 2
-                    cover = np.concatenate([
-                        self._bip[:prev],
-                        np.arange(prev, nv, dtype=np.int32),
-                        np.where(self._bip[prev:] >= prev,
-                                 self._bip[prev:] + (nv - prev),
-                                 self._bip[prev:]).astype(np.int32),
-                        np.arange(nv + prev, 2 * nv, dtype=np.int32)])
-                    self._bip = cover
-                s2, d2 = unionfind.double_cover_edges(s, d, nv)
+                # cover layout is VERTEX-BUCKET based ((+) = v,
+                # (−) = vb + v), so the kernel shape depends only on
+                # buckets: re-layout happens on the O(log V) bucket
+                # doublings, not on every window's new vertex count
+                # (which recompiled cc_fixpoint per window in round 2)
+                if len(self._bip) != 2 * self.vb:
+                    self._bip = self._grow_cover(self._bip, self.vb)
+                s2, d2 = unionfind.double_cover_edges(s, d, self.vb)
                 self._bip = unionfind.connected_components_with_labels(
-                    s2, d2, self._bip, 2 * nv)
-                _, _, odd = unionfind.decode_double_cover(self._bip, nv)
-                res.bipartite_odd = odd
+                    s2, d2, self._bip, 2 * self.vb)
+                _, _, odd = unionfind.decode_double_cover(self._bip,
+                                                          self.vb)
+                res.bipartite_odd = odd[:nv]
         elif name == "triangles":
             if sharded:
                 res.triangles = self._sh_tri.count(s, d)
@@ -448,12 +481,17 @@ class StreamingAnalyticsDriver:
             # the same windows the checkpointed run would have
             self.eb = int(state["edge_bucket"])
         if "vertex_bucket" in state:
-            # adopt the checkpointed capacity up front (it can only have
-            # grown past the constructor default); without this a
+            # adopt the checkpointed capacity up front; without this a
             # sharded resume built with a different vertex_bucket dies
             # deep in ShardedWindowEngine.load_state_dict with a
-            # 'vertex bucket mismatch' that never names the parameter
-            self.vb = int(state["vertex_bucket"])
+            # 'vertex bucket mismatch' that never names the parameter.
+            # Single-chip keeps a LARGER pre-sized constructor bucket
+            # (carried host mirrors re-lay out lazily), so resuming a
+            # small checkpoint doesn't re-introduce the bucket-doubling
+            # recompiles the caller pre-sized to avoid.
+            ckpt_vb = int(state["vertex_bucket"])
+            self.vb = (ckpt_vb if self.mesh is not None
+                       else max(self.vb, ckpt_vb))
             # force rebuild of everything compiled at the old capacity
             self._engine = None
             self._tri_kernel = None

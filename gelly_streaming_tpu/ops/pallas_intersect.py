@@ -1,0 +1,99 @@
+"""Pallas prototype of the dominant sparse kernel: per-edge sorted-row
+intersection counting (the `intersect_local` compare of
+ops/triangles.py:77-121, lowering WindowTriangles.java:61-140).
+
+The XLA lowering is a chunked broadcast equality compare whose
+[Ep, chunk, K] hit tensor is fused into its `any`-reduce by XLA. This
+kernel makes the fusion explicit: each grid step owns a TILE_E-edge
+slice, keeps the pre-gathered neighbor rows in VMEM, runs the K×K
+compare chunk loop entirely in registers/VMEM, and writes ONE partial
+count per tile — no intermediate ever exists in HBM.
+
+The row gather (nbr[ea], nbr[eb]) stays in XLA outside the kernel:
+dynamic row gathers from HBM inside a Pallas kernel would serialize
+into per-edge DMAs, and XLA's gather is already bandwidth-optimal.
+What the kernel can win is the compare loop's scheduling; what it can
+lose is XLA's fusion of the gather INTO the compare (which skips the
+[Ep, K] rows_a/rows_b round trip to HBM entirely). tools/
+profile_kernels.py measures both on-chip; ops/triangles.py keeps
+whichever the committed PERF.json says wins (see PERF.md).
+
+On non-TPU backends the kernel runs in interpreter mode (virtual CPU
+mesh tests), keeping behavior identical everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_E = 256     # edges per grid step
+CHUNK_K = 128    # compare-chunk width (lane-aligned)
+
+
+def _need_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _intersect_kernel(ra, rb, va, out):
+    """ra/rb: [TILE_E, K] int32 neighbor rows; va: [TILE_E, K] bool
+    validity of ra entries (sentinel/padding pre-masked). out: [1]
+    int32 partial count for this tile."""
+    k = ra.shape[1]
+    rb_val = rb[:]                                # [T, K] in VMEM
+    total = jnp.int32(0)
+    for c in range(-(-k // CHUNK_K)):
+        ck = min(CHUNK_K, k - c * CHUNK_K)
+        a_chunk = ra[:, pl.ds(c * CHUNK_K, ck)]   # [T, Ck]
+        v_chunk = va[:, pl.ds(c * CHUNK_K, ck)]
+        hit = jnp.any(
+            a_chunk[:, :, None] == rb_val[:, None, :], axis=2)  # [T, Ck]
+        total += jnp.sum(jnp.where(hit & v_chunk, 1, 0),
+                         dtype=jnp.int32)
+    out[0] = total
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _intersect_tiles(rows_a: jax.Array, rows_b: jax.Array,
+                     valid: jax.Array, interpret: bool) -> jax.Array:
+    ep, k = rows_a.shape
+    assert ep % TILE_E == 0, (ep, TILE_E)
+    g = ep // TILE_E
+    return pl.pallas_call(
+        _intersect_kernel,
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1,), lambda i: (i,),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((g,), jnp.int32),
+        interpret=interpret,
+    )(rows_a, rows_b, valid)
+
+
+def intersect_local_pallas(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
+                           emask: jax.Array) -> jax.Array:
+    """Drop-in for ops/triangles.intersect_local (same contract: count
+    of |N_out(a) ∩ N_out(b)| over all valid oriented edges)."""
+    sentinel = nbr.shape[0] - 1
+    ep = ea.shape[0]
+    pad = (-ep) % TILE_E
+    if pad:
+        ea = jnp.concatenate([ea, jnp.full(pad, sentinel, ea.dtype)])
+        eb = jnp.concatenate([eb, jnp.full(pad, sentinel, eb.dtype)])
+        emask = jnp.concatenate([emask, jnp.zeros(pad, emask.dtype)])
+    rows_a = nbr[ea]
+    rows_b = nbr[eb]
+    valid = (rows_a < sentinel) & emask[:, None]
+    partials = _intersect_tiles(rows_a, rows_b, valid, _need_interpret())
+    return jnp.sum(partials, dtype=jnp.int32)

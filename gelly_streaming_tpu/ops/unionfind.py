@@ -113,17 +113,28 @@ _cc_fixpoint_jit = jax.jit(cc_fixpoint)
 
 def connected_components_with_labels(src: np.ndarray, dst: np.ndarray,
                                      labels: np.ndarray,
-                                     num_vertices: int) -> np.ndarray:
+                                     num_vertices: int,
+                                     vertex_bucket: int = 0) -> np.ndarray:
     """Carried-state variant: fold a batch of edges into an existing
     labeling (streaming-iteration semantics, strategy P5). `labels` is a
     dense int32 [num_vertices] forest pointing at equal-or-smaller
-    slots; returns the converged labels of the same length."""
+    slots; returns the converged labels of the same length.
+
+    BOTH dimensions are bucketed — edges to the edge bucket, the label
+    vector to the vertex bucket (padding slots are isolated identity
+    labels; slot vb is the edge-padding sentinel) — so a stream whose
+    vertex count grows every window compiles O(log² ) programs, not one
+    per distinct count (a steady-state-recompile bug caught by
+    tools/scale_run.py's jax_log_compiles assert in round 2). Callers
+    that already hold a grown bucket (the streaming driver) pass it as
+    `vertex_bucket` so every window reuses ONE program."""
     e = len(src)
     eb = seg_ops.bucket_size(e)
-    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=num_vertices)
-    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=num_vertices)
+    vb = seg_ops.bucket_size(max(num_vertices, vertex_bucket))
+    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)
+    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)
     lab = np.concatenate([np.asarray(labels, np.int32),
-                          np.array([num_vertices], np.int32)])
+                          np.arange(num_vertices, vb + 1, dtype=np.int32)])
     out = np.asarray(_cc_fixpoint_jit(jnp.asarray(lab), jnp.asarray(s),
                                       jnp.asarray(d)))
     return out[:num_vertices]

@@ -91,6 +91,35 @@ def test_kernels_vs_brute_force(seed, kernel):
     assert fn(src, dst, n) == expected
 
 
+@pytest.mark.parametrize("seed", range(3))
+def test_pallas_intersect_matches_xla_compare(seed):
+    """The Pallas rows-intersect prototype (ops/pallas_intersect.py)
+    agrees with intersect_local on random sorted dedup'd rows,
+    including ragged (non-TILE_E-multiple) edge counts and padding."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops.pallas_intersect import \
+        intersect_local_pallas
+
+    rng = np.random.default_rng(seed)
+    # shapes chosen to exercise EVERY kernel dimension: ep=600 → three
+    # TILE_E=256 grid tiles (ragged final tile via padding), k=160 →
+    # two CHUNK_K=128 compare chunks (ragged final chunk of 32)
+    vb, k, ep = 64, 160, 600
+    fill = rng.integers(0, vb, size=(vb + 1, k)).astype(np.int32)
+    fill.sort(axis=1)
+    # dedupe within rows; duplicates become the sentinel
+    dup = np.concatenate(
+        [np.zeros((vb + 1, 1), bool), fill[:, 1:] == fill[:, :-1]], axis=1)
+    nbr = np.where(dup, vb, fill).astype(np.int32)
+    ea = rng.integers(0, vb, ep).astype(np.int32)
+    eb_ = rng.integers(0, vb, ep).astype(np.int32)
+    emask = rng.random(ep) < 0.9
+    args = tuple(jnp.asarray(x) for x in (nbr, ea, eb_, emask))
+    assert int(intersect_local_pallas(*args)) == int(
+        tri_ops.intersect_local(*args))
+
+
 def test_streaming_window_kernel_matches_sparse():
     """Fixed-shape streaming engine (one compile for all windows) agrees
     with the dynamic host path across windows of varying size/shape."""

@@ -278,6 +278,35 @@ def test_driver_count_based_partial_window_guard():
     drv.run_arrays(src[:8], (src[:8] + 1) % 5)
 
 
+def test_partial_window_flag_not_persisted_before_final_window(tmp_path):
+    """A mid-call checkpoint taken BEFORE the call's short final window
+    must not record closed_partial: a crash between that checkpoint and
+    the short window would otherwise leave a state that refuses an
+    exact replay of the remaining edges (code-review r2 finding)."""
+    ckpt = str(tmp_path / "ck.npz")
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=8,
+                                   analytics=("degrees",))
+    drv.enable_auto_checkpoint(ckpt, every_n_windows=1)
+    src = np.arange(20) % 5  # 2 full windows + partial 4-edge window
+    drv.run_arrays(src, (src + 1) % 5)
+    assert drv._closed_partial  # live driver did close the short window
+
+    # "crash" after window 2's checkpoint: simulate by resuming a
+    # checkpoint cut at windows_done=2 (the every-window cadence means
+    # the final checkpoint has 3 windows; rebuild the 2-window one)
+    fresh = StreamingAnalyticsDriver(window_ms=0, edge_bucket=8,
+                                     analytics=("degrees",))
+    fresh.enable_auto_checkpoint(ckpt, every_n_windows=1)
+    fresh.run_arrays(src[:16], (src[:16] + 1) % 5)  # exactly 2 windows
+    resumed = StreamingAnalyticsDriver(window_ms=0, edge_bucket=8,
+                                       analytics=("degrees",))
+    assert resumed.try_resume(ckpt)
+    assert not resumed._closed_partial
+    # replaying the remaining edges must succeed and close the stream
+    out = resumed.run_arrays(src[16:], (src[16:] + 1) % 5)
+    assert len(out) == 1 and out[-1].num_edges == 4
+
+
 def test_driver_reset_gives_clean_rerun():
     drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=8,
                                    analytics=("degrees", "cc"))
@@ -304,7 +333,17 @@ def test_driver_checkpoint_carries_vertex_bucket(tmp_path):
     b = StreamingAnalyticsDriver(window_ms=0, vertex_bucket=1 << 12,
                                  edge_bucket=8, analytics=("degrees",))
     assert b.try_resume(p)
-    assert b.vb == a.vb
+    # single-chip keeps the LARGER pre-sized constructor bucket (so a
+    # caller who pre-sized to avoid bucket-doubling recompiles doesn't
+    # get them back after resume); a smaller constructor adopts the
+    # checkpoint's grown bucket (code-review r2 finding)
+    assert b.vb == 1 << 12
+    c = StreamingAnalyticsDriver(window_ms=0, vertex_bucket=16,
+                                 edge_bucket=8, analytics=("degrees",))
+    assert c.try_resume(p)
+    assert c.vb == a.vb
     ra = a.run_arrays(src[:8], (src[:8] + 3) % 40)
     rb = b.run_arrays(src[:8], (src[:8] + 3) % 40)
+    rc = c.run_arrays(src[:8], (src[:8] + 3) % 40)
     np.testing.assert_array_equal(ra[-1].degrees, rb[-1].degrees)
+    np.testing.assert_array_equal(ra[-1].degrees, rc[-1].degrees)

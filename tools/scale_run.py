@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Scale validation (VERDICT r1 item 7): a deterministic ≥10M-edge
+timestamped stream pushed through the real ingest paths, with the
+vertex domain growing past 2^16 mid-stream so the driver's bucket-
+doubling (O(log V) recompiles, then steady state) is exercised at
+scale. Emits one JSON line per leg and writes SCALE_r02.json.
+
+Legs:
+  driver   — StreamingAnalyticsDriver.stream_file (bounded-memory C++
+             chunk parse -> event-time windows -> all four analytics),
+             with a jax_log_compiles listener asserting NO compile
+             lands in the steady-state tail of the stream.
+  fused    — StreamSummaryEngine.process over the same edges (the
+             one-dispatch-per-64-windows throughput path).
+  sharded  — ShardedSummaryEngine on the virtual 8-device CPU mesh
+             (subprocess; the backend pin must precede jax import).
+
+The fixture file is generated to --out (default /tmp, ~190MB — the
+GENERATOR is committed, the data is reproducible, BASELINE.json names
+real datasets this zero-egress image cannot download).
+"""
+
+import argparse
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np
+
+NUM_EDGES = int(os.environ.get("GS_SCALE_EDGES", 10_000_000))
+EDGES_PER_WINDOW = int(os.environ.get("GS_SCALE_WINDOW", 65_536))
+WINDOW_MS = 1_000
+V_START = 4_096       # driver's default vertex bucket: growth starts at once
+# crosses 2^16 mid-stream -> bucket doubling under load
+V_END = int(os.environ.get("GS_SCALE_VEND", 262_144))
+SEED = 11
+
+
+def generate(path: str) -> None:
+    """Deterministic 'src dst ts' fixture: Zipf-ish endpoints over a
+    vertex domain that widens linearly from V_START to V_END across the
+    stream (new vertices keep arriving, the way a real edge stream's id
+    space grows), timestamps ascending with exactly EDGES_PER_WINDOW
+    edges per WINDOW_MS event-time window."""
+    rng = np.random.default_rng(SEED)
+    t0 = time.perf_counter()
+    with open(path, "w") as f:
+        at = 0
+        while at < NUM_EDGES:
+            n = min(EDGES_PER_WINDOW, NUM_EDGES - at)
+            # domain grows with stream position; ranks drawn by inverse-
+            # CDF of a power law (cheap, no per-draw choice(p=...))
+            vmax = V_START + (V_END - V_START) * at // NUM_EDGES
+            u = rng.random((2, n))
+            ids = ((vmax ** u) - 1).astype(np.int64)  # ~Zipf over [0,vmax)
+            ts = np.full(n, (at // EDGES_PER_WINDOW) * WINDOW_MS)
+            # scatter hot ids over the space deterministically
+            s = (ids[0] * 2654435761) % vmax
+            d = (ids[1] * 2246822519) % vmax
+            d = np.where(s == d, (d + 1) % vmax, d)
+            np.savetxt(f, np.stack([s, d, ts], 1), fmt="%d")
+            at += n
+    print(json.dumps({
+        "leg": "generate", "edges": NUM_EDGES, "path": path,
+        "bytes": os.path.getsize(path),
+        "seconds": round(time.perf_counter() - t0, 1)}), flush=True)
+
+
+class CompileCounter(logging.Handler):
+    """Counts XLA compiles via jax_log_compiles ('Finished tracing +
+    compiling ...' records on the jax logger tree)."""
+
+    def __init__(self):
+        super().__init__()
+        self.events = []
+
+    def emit(self, record):
+        msg = record.getMessage()
+        if "compiling" in msg.lower():
+            self.events.append(msg)
+
+
+def run_driver(path: str) -> dict:
+    import jax
+
+    from gelly_streaming_tpu import StreamingAnalyticsDriver
+
+    jax.config.update("jax_log_compiles", True)
+    counter = CompileCounter()
+    logging.getLogger("jax").addHandler(counter)
+    logging.getLogger("jax").setLevel(logging.WARNING)
+    for name in ("jax._src.interpreters.pxla", "jax._src.dispatch"):
+        lg = logging.getLogger(name)
+        lg.setLevel(logging.DEBUG)
+        lg.addHandler(counter)
+
+    drv = StreamingAnalyticsDriver(window_ms=WINDOW_MS, tracing=True)
+    t0 = time.perf_counter()
+    windows = 0
+    tail_start_compiles = None
+    total_w = NUM_EDGES // EDGES_PER_WINDOW
+    last_result = None
+    for res in drv.stream_file(path, chunk_bytes=1 << 26):
+        windows += 1
+        last_result = res
+        if windows == (3 * total_w) // 4:
+            tail_start_compiles = len(counter.events)
+    elapsed = time.perf_counter() - t0
+    jax.config.update("jax_log_compiles", False)
+
+    tail_compiles = (len(counter.events) - tail_start_compiles
+                     if tail_start_compiles is not None else -1)
+    assert tail_compiles == 0, (
+        "steady-state recompiles detected in the final quarter of the "
+        "stream:\n" + "\n".join(counter.events[tail_start_compiles:]))
+    assert last_result is not None
+    nv = len(last_result.vertex_ids)
+    # the bucket must have grown to hold the fixture's final vertex
+    # domain (past 2^16 at the real V_END=262144) — proves doubling
+    # happened mid-stream, under load
+    need = V_START
+    while need < V_END // 2:
+        need *= 2
+    assert drv.vb >= need, (
+        f"fixture never grew the vertex bucket (vb={drv.vb}, "
+        f"expected >= {need} for a {V_END}-vertex domain)")
+    return {
+        "leg": "driver-stream_file",
+        "backend": jax.default_backend(),
+        "edges": NUM_EDGES,
+        "windows": windows,
+        "vertices_final": nv,
+        "vertex_bucket_final": drv.vb,
+        "edges_per_sec": round(NUM_EDGES / elapsed),
+        "compiles_total": len(counter.events),
+        "compiles_steady_state_tail": tail_compiles,
+        "trace": drv.trace_report(),
+    }
+
+
+def run_fused(path: str) -> dict:
+    import jax
+
+    from gelly_streaming_tpu.io.sources import load_edge_arrays
+    from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+    from gelly_streaming_tpu.ops.segment import intern
+
+    src, dst, _ts = load_edge_arrays(path)
+    _uniq, (s, d) = intern(src, dst)
+    eng = StreamSummaryEngine(edge_bucket=EDGES_PER_WINDOW,
+                              vertex_bucket=int(max(s.max(), d.max())) + 1)
+    # compile both chunk shapes + the overflow fallback outside timing
+    num_w = -(-len(s) // eng.eb)
+    for w in {min(num_w, eng.MAX_WINDOWS), num_w % eng.MAX_WINDOWS}:
+        if w:
+            zeros = np.zeros(w * eng.eb, np.int32)
+            eng.process(zeros, zeros)
+            eng.reset()
+    eng.warm_fallback()
+    t0 = time.perf_counter()
+    out = eng.process(s, d)
+    elapsed = time.perf_counter() - t0
+    return {
+        "leg": "fused-scan",
+        "backend": jax.default_backend(),
+        "edges": len(s),
+        "windows": len(out),
+        "edges_per_sec": round(len(s) / elapsed),
+        "final_summary": out[-1],
+    }
+
+
+def run_sharded(path: str) -> dict:
+    code = r"""
+import json, sys, time
+import numpy as np
+sys.path.insert(0, %(repo)r)
+from gelly_streaming_tpu.core.platform import cpu_mesh
+cpu_mesh(8)
+from gelly_streaming_tpu.io.sources import load_edge_arrays
+from gelly_streaming_tpu.ops.segment import intern
+from gelly_streaming_tpu.parallel.mesh import make_mesh
+from gelly_streaming_tpu.parallel.sharded import ShardedSummaryEngine
+
+src, dst, _ts = load_edge_arrays(%(path)r)
+# the virtual CPU mesh is a sharding-correctness leg, not a perf leg:
+# an eighth of the stream bounds the wall-clock
+n = len(src) // 8
+_uniq, (s, d) = intern(src[:n], dst[:n])
+eng = ShardedSummaryEngine(make_mesh(), edge_bucket=%(epw)d,
+                           vertex_bucket=int(max(s.max(), d.max())) + 1)
+zeros = np.zeros(min(-(-len(s) // eng.eb), eng.MAX_WINDOWS) * eng.eb,
+                 np.int32)
+eng.process(zeros, zeros)
+eng.reset()
+eng.warm_fallback()
+t0 = time.perf_counter()
+out = eng.process(s, d)
+elapsed = time.perf_counter() - t0
+print(json.dumps({
+    "leg": "sharded-fused-scan", "backend": "cpu-virtual-mesh",
+    "devices": 8, "edges": len(s), "windows": len(out),
+    "edges_per_sec": round(len(s) / elapsed),
+    "final_summary": out[-1]}))
+""" % {"repo": REPO, "path": path, "epw": EDGES_PER_WINDOW}
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    res = subprocess.run([sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=3600)
+    if res.returncode != 0:
+        return {"leg": "sharded-fused-scan", "error": res.stderr[-800:]}
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="/tmp/gs_scale_fixture.txt")
+    ap.add_argument("legs", nargs="*",
+                    default=["driver", "fused", "sharded"])
+    args = ap.parse_args()
+
+    if not os.path.exists(args.out):
+        generate(args.out)
+    results = {"num_edges": NUM_EDGES, "edges_per_window": EDGES_PER_WINDOW,
+               "v_start": V_START, "v_end": V_END, "seed": SEED,
+               "legs": []}
+    for leg in args.legs:
+        r = {"driver": run_driver, "fused": run_fused,
+             "sharded": run_sharded}[leg](args.out)
+        results["legs"].append(r)
+        print(json.dumps(r), flush=True)
+    with open(os.path.join(REPO, "SCALE_r02.json"), "w") as f:
+        json.dump(results, f, indent=2)
+    print("wrote SCALE_r02.json", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()
