@@ -249,6 +249,18 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
 
 def main():
     metric_suffix = ""
+    if os.environ.get("GS_BENCH_CHILD"):
+        # child mode (checked FIRST — a child must never re-enter the
+        # scale ladder): the parent already probed the backend and
+        # chose the suffix; pin CPU when the parent did, then run the
+        # one scale
+        if "--cpu" in sys.argv or os.environ.get(
+                "GS_BENCH_CPU_FALLBACK") == "1":
+            from gelly_streaming_tpu.core.platform import use_cpu
+            use_cpu()
+        run_one_scale_child(float(os.environ["GS_BENCH_CHILD"]),
+                            os.environ.get("GS_BENCH_SUFFIX", ""))
+        return
     if "--cpu" in sys.argv:
         from gelly_streaming_tpu.core.platform import use_cpu
         use_cpu()
@@ -286,20 +298,78 @@ def main():
     scale = float(os.environ.get("BENCH_SCALE", "5.0"))
     done = 0
     for attempt in (scale / 80, scale / 20, scale):
-        try:
-            run_at_scale(attempt, metric_suffix)
+        rc = run_scale_watchdogged(attempt, metric_suffix)
+        if rc == 0:
             done += 1
-        except AssertionError:
-            raise  # parity failure: NEVER mask a correctness regression
-        except Exception as e:
-            if done and (_is_resource_error(e) or _is_backend_drop(e)):
-                # device limit / backend death at this scale: keep the
-                # completed smaller-scale result on stdout
-                print("bench stopped at scale %g (%s: %s); keeping "
-                      "completed scales" % (attempt, type(e).__name__, e),
-                      file=sys.stderr)
-                break
-            raise  # genuine bug: surface immediately, no slow retries
+            continue
+        if rc == EXIT_CAPACITY and done:
+            # device limit / backend death at this scale: keep the
+            # completed smaller-scale results on stdout
+            print("bench stopped at scale %g (capacity/backend); "
+                  "keeping completed scales" % attempt, file=sys.stderr)
+            break
+        if rc == EXIT_TIMEOUT and done:
+            # a wedged remote compile (round 2: a single big-window
+            # compile stalled the tunnel >30 min) must not eat the
+            # window; completed scales are already on stdout
+            print("bench scale %g timed out (wedged backend?); "
+                  "keeping completed scales" % attempt, file=sys.stderr)
+            break
+        # nothing completed (timeout/capacity at the smallest scale) or
+        # a genuine bug (incl. parity): a green exit with no metric
+        # lines must be impossible
+        sys.exit(rc or 1)
+
+
+EXIT_CAPACITY = 3
+EXIT_TIMEOUT = 4
+
+
+def run_one_scale_child(attempt: float, metric_suffix: str) -> None:
+    try:
+        run_at_scale(attempt, metric_suffix)
+    except AssertionError:
+        raise  # parity failure: NEVER mask a correctness regression
+    except Exception as e:
+        if _is_resource_error(e) or _is_backend_drop(e):
+            print("scale %g: %s: %s" % (attempt, type(e).__name__, e),
+                  file=sys.stderr)
+            sys.exit(EXIT_CAPACITY)
+        raise
+
+
+def run_scale_watchdogged(attempt: float, metric_suffix: str) -> int:
+    """Run one scale in a subprocess with a hard timeout, streaming its
+    stdout through. A hung remote compile gets SIGKILLed (process
+    group) instead of stalling the whole bench."""
+    import signal
+
+    timeout_s = int(os.environ.get("GS_BENCH_SCALE_TIMEOUT", "1500"))
+    env = dict(os.environ, GS_BENCH_CHILD=repr(attempt),
+               GS_BENCH_SUFFIX=metric_suffix)
+    p = subprocess.Popen([sys.executable] + sys.argv, env=env,
+                         stdout=subprocess.PIPE, text=True,
+                         start_new_session=True)
+    import threading
+
+    def pump():
+        for line in p.stdout:
+            sys.stdout.write(line)
+            sys.stdout.flush()
+
+    t = threading.Thread(target=pump, daemon=True)
+    t.start()
+    try:
+        rc = p.wait(timeout=timeout_s)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        p.wait()
+        rc = EXIT_TIMEOUT
+    t.join(timeout=5)
+    return rc
 
 
 if __name__ == "__main__":

@@ -74,15 +74,25 @@ class JaxEdgesFold:
 class JaxEdgesReduce:
     """Device reduce of neighborhood edge values.
 
-    `name` selects a fully-parallel monoid kernel ('sum'|'min'|'max');
-    otherwise `fn(a, b)` runs as a segmented scan in arrival order.
+    Three performance tiers (fastest first):
+    - `name` ('sum'|'min'|'max') — fully-parallel named monoid
+      segment kernel;
+    - `fn` + `associative=True` — O(log E) flagged associative scan
+      (the combine tree reorders applications, which associativity
+      licenses);
+    - `fn` alone — O(E) sequential segmented scan in exact arrival
+      order (the reference's incremental pane semantics,
+      GraphWindowStream.java:107-121); correct for any fn, but
+      latency-bound — prefer the tiers above when the fn qualifies.
     """
 
-    def __init__(self, fn=None, name: Optional[str] = None):
+    def __init__(self, fn=None, name: Optional[str] = None,
+                 associative: bool = False):
         if fn is None and name is None:
             raise ValueError("need fn or name")
         self.fn = fn
         self.name = name
+        self.associative = associative
 
 
 class JaxEdgesApply:

@@ -164,9 +164,10 @@ def _device_reduce_kernel(reduce_udf: JaxEdgesReduce):
             has_any = np.ones(n_seg, bool)
         else:
             order = np.argsort(s_dense, kind="stable")
-            res, has_any = seg_ops.segmented_reduce(
-                fn, s_dense[order], val[order], n_seg
-            )
+            reduce = (seg_ops.segmented_reduce_associative
+                      if getattr(reduce_udf, "associative", False)
+                      else seg_ops.segmented_reduce)
+            res, has_any = reduce(fn, s_dense[order], val[order], n_seg)
             res = np.asarray(res)
         return [
             ((_py(uniq[i]), _py(res[i])), wmax)

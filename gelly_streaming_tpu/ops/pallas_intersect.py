@@ -31,12 +31,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .pallas_triangles import _need_interpret
+
 TILE_E = 256     # edges per grid step
 CHUNK_K = 128    # compare-chunk width (lane-aligned)
-
-
-def _need_interpret() -> bool:
-    return jax.default_backend() != "tpu"
 
 
 def _intersect_kernel(ra, rb, va, out):

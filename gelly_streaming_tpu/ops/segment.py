@@ -141,6 +141,55 @@ def segmented_fold(fold_fn: Callable, init_tree: Any, segment_ids: np.ndarray,
     )
 
 
+@functools.lru_cache(maxsize=256)
+def _jit_assoc_reduce(reduce_fn, num_segments_bucket):
+    @jax.jit
+    def run(seg, mask, vals):
+        n = seg.shape[0]
+        flags = jnp.concatenate(
+            [jnp.array([True]), seg[1:] != seg[:-1]])
+
+        def comb(a, b):
+            fa, va = a
+            fb, vb = b
+            # segment-start flag resets the running combine: classic
+            # flagged associative scan (requires reduce_fn associative)
+            return fa | fb, jnp.where(fb, vb, reduce_fn(va, vb))
+
+        _, scanned = jax.lax.associative_scan(comb, (flags, vals))
+        idx = jnp.arange(n)
+        last_idx = jax.ops.segment_max(
+            jnp.where(mask, idx, -1), seg, num_segments_bucket + 1
+        )[:num_segments_bucket]
+        has_any = last_idx >= 0
+        return scanned[jnp.maximum(last_idx, 0)], has_any
+
+    return run
+
+
+def segmented_reduce_associative(reduce_fn: Callable,
+                                 segment_ids: np.ndarray,
+                                 values: np.ndarray, num_segments: int):
+    """Per-segment reduce for a user fn DECLARED associative: a flagged
+    `lax.associative_scan` runs in O(log E) parallel steps instead of
+    the O(E) sequential pane scan of `segmented_reduce` — the fast tier
+    between named monoids and arbitrary fns (the combine tree reorders
+    the applications, which is exactly what associativity licenses).
+    Same contract as segmented_reduce: segment_ids sorted (stable),
+    returns (results[num_segments], has_any[num_segments])."""
+    values = np.asarray(values)
+    n = segment_ids.shape[0]
+    nb = bucket_size(n)
+    sb = bucket_size(num_segments)
+    seg = pad_to(np.asarray(segment_ids, np.int32), nb, fill=sb)
+    mask = pad_to(np.ones(n, bool), nb, fill=False)
+    vals = pad_to(values, nb)
+    res, has_any = _jit_assoc_reduce(reduce_fn, sb)(
+        jnp.asarray(seg), jnp.asarray(mask), jnp.asarray(vals))
+    return (np.asarray(res[:num_segments]),
+            np.asarray(has_any[:num_segments]))
+
+
 def segmented_reduce(reduce_fn: Callable, segment_ids: np.ndarray,
                      values: np.ndarray, num_segments: int):
     """Generic per-segment reduce of edge values in arrival order

@@ -71,12 +71,36 @@ def test_reduce_on_edges_host(env, direction):
 
 
 @pytest.mark.parametrize("direction", DIRECTIONS)
-@pytest.mark.parametrize("spec", ["named", "generic"])
+@pytest.mark.parametrize("spec", ["named", "generic", "associative"])
 def test_reduce_on_edges_device(env, direction, spec):
-    reduce_udf = (JaxEdgesReduce(name="sum") if spec == "named"
-                  else JaxEdgesReduce(fn=lambda a, b: a + b))
+    reduce_udf = (
+        JaxEdgesReduce(name="sum") if spec == "named"
+        else JaxEdgesReduce(fn=lambda a, b: a + b,
+                            associative=(spec == "associative")))
     sums = _graph(env).slice(Time.seconds(1), direction).reduce_on_edges(reduce_udf)
     assert run_and_sort(env, sums) == sorted(FOLD_EXPECTED[direction])
+
+
+def test_segmented_reduce_associative_matches_sequential():
+    """The O(log E) flagged associative-scan tier agrees with the
+    sequential arrival-order tier for associative fns — including a
+    non-commutative one (take-right), which pins the arrival ORDER
+    inside each segment, not just the multiset of values."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gelly_streaming_tpu.ops import segment as seg_ops
+
+    rng = np.random.default_rng(5)
+    n, n_seg = 999, 37
+    seg = np.sort(rng.integers(0, n_seg, n)).astype(np.int32)
+    vals = rng.integers(1, 100, n).astype(np.int32)
+    for fn in (jnp.add, jnp.maximum, lambda a, b: b):  # b: take-right
+        fast, fh = seg_ops.segmented_reduce_associative(
+            fn, seg, vals, n_seg)
+        slow, sh = seg_ops.segmented_reduce(fn, seg, vals, n_seg)
+        np.testing.assert_array_equal(fh, sh)
+        np.testing.assert_array_equal(fast[fh], np.asarray(slow)[sh])
 
 
 @pytest.mark.parametrize("direction", DIRECTIONS)
