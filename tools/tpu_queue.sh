@@ -1,0 +1,35 @@
+#!/bin/bash
+# TPU work queue: poll the tunnel; when it answers, run the round's
+# evidence suite sequentially (bench -> kernel profile -> scale run).
+# Each stage logs to /tmp/tpuq_*.log; the queue stops polling after
+# MAX_WAIT_S without a live backend.
+set -u
+MAX_WAIT_S=${MAX_WAIT_S:-14400}
+POLL_S=${POLL_S:-180}
+cd /root/repo
+
+waited=0
+while true; do
+  if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M:%S) tunnel is up" ; break
+  fi
+  waited=$((waited + POLL_S))
+  if [ "$waited" -ge "$MAX_WAIT_S" ]; then
+    echo "$(date -u +%H:%M:%S) gave up waiting for tunnel"; exit 2
+  fi
+  echo "$(date -u +%H:%M:%S) tunnel down; waited ${waited}s"
+  sleep "$POLL_S"
+done
+
+echo "=== stage 1: bench.py ==="
+timeout 5400 python bench.py >/tmp/tpuq_bench.log 2>/tmp/tpuq_bench.err
+echo "bench rc=$? ; $(tail -1 /tmp/tpuq_bench.log 2>/dev/null)"
+
+echo "=== stage 2: profile_kernels ==="
+timeout 5400 python tools/profile_kernels.py >/tmp/tpuq_prof.log 2>/tmp/tpuq_prof.err
+echo "profile rc=$?"
+
+echo "=== stage 3: scale_run (driver+fused on chip, sharded on cpu mesh) ==="
+timeout 7200 python tools/scale_run.py >/tmp/tpuq_scale.log 2>/tmp/tpuq_scale.err
+echo "scale rc=$?"
+echo "queue done"
