@@ -349,7 +349,10 @@ class TriangleWindowKernel:
     def _run_stack(self, s, d, valid, get_window) -> list:
         """Dispatch a [W, eb] window stack in MAX_STREAM_WINDOWS chunks;
         `get_window(w)` returns the raw (src, dst) of window w for the
-        rare exact overflow recount."""
+        rare exact overflow recount. The window axis of a ragged final
+        chunk pads to a power-of-two bucket (all-invalid rows), so
+        varying stream lengths reuse O(log MAX_STREAM_WINDOWS) compiled
+        programs instead of one per distinct tail length."""
         if self.kb not in self._stream_fns:
             self._stream_fns[self.kb] = self._build_stream(self.kb)
         fn = self._stream_fns[self.kb]
@@ -357,10 +360,15 @@ class TriangleWindowKernel:
         counts: list = []
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            c, o = fn(jnp.asarray(s[at:hi]), jnp.asarray(d[at:hi]),
-                      jnp.asarray(valid[at:hi]))
+            n = hi - at
+            wb = min(seg_ops.bucket_size(n), self.MAX_STREAM_WINDOWS)
+            sc = np.full((wb, self.eb), self.vb, np.int32)
+            dc = np.full((wb, self.eb), self.vb, np.int32)
+            vc = np.zeros((wb, self.eb), bool)
+            sc[:n], dc[:n], vc[:n] = s[at:hi], d[at:hi], valid[at:hi]
+            c, o = fn(jnp.asarray(sc), jnp.asarray(dc), jnp.asarray(vc))
             # np.array (not asarray): device outputs can be read-only
-            c, o = np.array(c), np.array(o)
+            c, o = np.array(c)[:n], np.array(o)[:n]
             for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
                 ws, wd = get_window(at + int(w))
                 c[w] = self.count(ws, wd, min_k=self.kb)

@@ -321,6 +321,41 @@ class ShardedTriangleWindowKernel:
             self._fns[key] = run_stream
         return self._fns[key]
 
+    def _run_stack(self, s, d, valid, get_window) -> list:
+        """Dispatch a [W, eb] window stack in MAX_STREAM_WINDOWS chunks
+        (edge axis sharded over the mesh); `get_window(w)` returns the
+        raw (src, dst) of window w for the rare exact overflow recount.
+        Ragged final chunks pad the window axis to a power-of-two
+        bucket so varying stream lengths reuse O(log) compiled
+        programs."""
+        from jax.sharding import NamedSharding
+
+        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
+        fn = self._stream_fn(self.kb, self.cap)
+        num_w = s.shape[0]
+        counts: list = []
+        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
+            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
+            n = hi - at
+            wb = min(seg_ops.bucket_size(n), self.MAX_STREAM_WINDOWS)
+            sc = np.full((wb, self.eb), self.vb, np.int32)
+            dc = np.full((wb, self.eb), self.vb, np.int32)
+            vc = np.zeros((wb, self.eb), bool)
+            sc[:n], dc[:n], vc[:n] = s[at:hi], d[at:hi], valid[at:hi]
+            args = (jax.device_put(sc, sharding),
+                    jax.device_put(dc, sharding),
+                    jax.device_put(vc, sharding))
+            # np.array (not asarray): device outputs are read-only views
+            c, b_ovf, k_ovf = (np.array(x)[:n] for x in fn(*args))
+            for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
+                ws, wd = get_window(at + int(w))
+                c[w] = self.count(
+                    ws, wd,
+                    failed_kb=self.kb if int(k_ovf[w]) else 0,
+                    failed_cap=self.cap if int(b_ovf[w]) else 0)
+            counts.extend(int(x) for x in c)
+        return counts
+
     def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
         """Exact counts of every tumbling `edge_bucket`-sized window,
         batched into one sharded program per MAX_STREAM_WINDOWS windows
@@ -328,32 +363,38 @@ class ShardedTriangleWindowKernel:
         COO chunk is laid out [W, eb] with the edge axis sharded over
         the mesh, a lax.map folds the windows, and overflowing windows
         are recounted individually down the escalation ladder."""
-        from jax.sharding import NamedSharding
-
         src = np.asarray(src, np.int32)
         dst = np.asarray(dst, np.int32)
         if len(src) == 0:
             return []
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
                                                   sentinel=self.vb)
-        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
-        fn = self._stream_fn(self.kb, self.cap)
-        counts: list = []
-        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
-            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            args = (jax.device_put(s[at:hi], sharding),
-                    jax.device_put(d[at:hi], sharding),
-                    jax.device_put(valid[at:hi], sharding))
-            # np.array (not asarray): device outputs are read-only views
-            c, b_ovf, k_ovf = (np.array(x) for x in fn(*args))
-            for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
-                lo_e = (at + int(w)) * self.eb
-                c[w] = self.count(
-                    src[lo_e:lo_e + self.eb], dst[lo_e:lo_e + self.eb],
-                    failed_kb=self.kb if int(k_ovf[w]) else 0,
-                    failed_cap=self.cap if int(b_ovf[w]) else 0)
-            counts.extend(int(x) for x in c)
-        return counts
+        eb = self.eb
+        return self._run_stack(
+            s, d, valid,
+            lambda w: (src[w * eb:(w + 1) * eb], dst[w * eb:(w + 1) * eb]))
+
+    def count_windows(self, windows) -> list:
+        """Exact counts of a list of (src, dst) window batches of
+        varying lengths (each ≤ edge_bucket) in chunked sharded
+        dispatches — the multi-chip form of
+        TriangleWindowKernel.count_windows (used by the driver's
+        batched event-time windows)."""
+        if not windows:
+            return []
+        num_w = len(windows)
+        s = np.full((num_w, self.eb), self.vb, np.int32)
+        d = np.full((num_w, self.eb), self.vb, np.int32)
+        valid = np.zeros((num_w, self.eb), bool)
+        for w, (ws, wd) in enumerate(windows):
+            n = len(ws)
+            if n > self.eb:
+                raise ValueError(f"window of {n} edges exceeds edge "
+                                 f"bucket {self.eb}")
+            s[w, :n] = ws
+            d[w, :n] = wd
+            valid[w, :n] = True
+        return self._run_stack(s, d, valid, lambda w: windows[w])
 
 
 # ----------------------------------------------------------------------

@@ -86,3 +86,45 @@ def test_native_interner_matches_python():
     assert len(nat) == len(py)
     dense = np.arange(len(nat), dtype=np.int32)
     assert list(nat.ids_of(dense)) == py.ids_of(dense)
+
+
+def test_iter_edge_chunks_prefetch_matches_sync(tmp_path):
+    """The producer-thread prefetch path yields byte-identical chunks
+    in order, propagates parse errors, and shuts its thread down when
+    the consumer abandons mid-stream."""
+    import threading
+
+    import numpy as np
+
+    from gelly_streaming_tpu.io.sources import iter_edge_chunks
+
+    p = tmp_path / "edges.txt"
+    rng = np.random.default_rng(2)
+    rows = ["%d %d %d" % (rng.integers(0, 99), rng.integers(0, 99), t)
+            for t in range(5000)]
+    p.write_text("\n".join(rows) + "\n")
+
+    sync = list(iter_edge_chunks(str(p), chunk_bytes=4096, prefetch=0))
+    pre = list(iter_edge_chunks(str(p), chunk_bytes=4096, prefetch=3))
+    assert len(sync) == len(pre) > 1
+    for a, b in zip(sync, pre):
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+
+    # abandon mid-stream: the producer thread must exit
+    before = threading.active_count()
+    it = iter_edge_chunks(str(p), chunk_bytes=512, prefetch=1)
+    next(it)
+    it.close()
+    for _ in range(100):
+        if threading.active_count() <= before:
+            break
+        import time
+        time.sleep(0.02)
+    assert threading.active_count() <= before
+
+    # a missing file raises in the CONSUMER, not silently in the thread
+    import pytest
+
+    with pytest.raises(OSError):
+        list(iter_edge_chunks(str(tmp_path / "missing.txt"), prefetch=2))
