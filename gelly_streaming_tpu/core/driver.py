@@ -47,7 +47,8 @@ from ..utils.tracing import StepTimer
 def _build_snapshot_scan(vb: int, analytics: tuple):
     """One jitted lax.scan over a [W, eb] window stack, carrying
     (degrees, cc labels, double-cover labels) and emitting PER-WINDOW
-    snapshots — the driver's batched single-chip fast path: one
+    snapshots — the driver's batched single-chip fast path (sharded
+    meshes use parallel.sharded.make_sharded_snapshot_scan): one
     dispatch + one d2h per run_arrays call instead of one per analytic
     per window (dispatch latency through a tunneled chip ~0.2s
     dominates per-window economics). Cover layout matches the driver's
@@ -317,10 +318,19 @@ class StreamingAnalyticsDriver:
                           count_based: bool = False
                           ) -> List[WindowResult]:
         """Route a call's windows: the batched snapshot-scan fast path
-        on single-chip multi-window calls, the per-window path (with
+        on multi-window calls (single-chip jit or shard_map over the
+        mesh), the per-window path (with
         batched triangle dispatch) otherwise."""
+        batched_ok = len(windows) > 1
+        if batched_ok and self.mesh is not None:
+            from ..parallel.mesh import shard_count
+
+            # shard_map splits the edge axis: the stack's eb must
+            # divide evenly (power-of-two buckets on power-of-two
+            # meshes always do)
+            batched_ok = self.eb % shard_count(self.mesh) == 0
         with self._batched_triangles():
-            if self.mesh is None and len(windows) > 1:
+            if batched_ok:
                 return self._run_batched(
                     windows,
                     closes_partial=(count_based
@@ -337,7 +347,8 @@ class StreamingAnalyticsDriver:
             return out
 
     # ------------------------------------------------------------------
-    # batched single-chip fast path: all of a call's windows in one
+    # batched fast path (single-chip or sharded): all of a call's
+    # windows in one
     # snapshot-scan dispatch (+ one count_windows dispatch)
     # ------------------------------------------------------------------
     _SCAN_CHUNK = 64  # max windows per dispatch; W pads to buckets
@@ -351,8 +362,14 @@ class StreamingAnalyticsDriver:
             self._scan_cache = {}
             self._scan_cache_key = key[:3]
         if wb not in self._scan_cache:
-            self._scan_cache[wb] = _build_snapshot_scan(
-                self.vb, self.analytics)
+            if self.mesh is not None:
+                from ..parallel.sharded import make_sharded_snapshot_scan
+
+                self._scan_cache[wb] = make_sharded_snapshot_scan(
+                    self.mesh, self.vb, self.analytics)
+            else:
+                self._scan_cache[wb] = _build_snapshot_scan(
+                    self.vb, self.analytics)
         return self._scan_cache[wb], wb
 
     def _run_batched(self, windows,
@@ -360,8 +377,9 @@ class StreamingAnalyticsDriver:
         """Process [(wstart, src, dst), ...] with ONE snapshot-scan
         dispatch per _SCAN_CHUNK windows and one batched triangle
         dispatch, instead of per-window per-analytic round trips.
-        Single-chip only; semantics identical to the per-window path
-        (same kernels, same carried state, same snapshots).
+        Semantics identical to the per-window path (same fixpoint
+        kernels and carried state; single-chip carries the host
+        mirrors, sharded carries the ShardedWindowEngine's state).
 
         Consistency unit = one chunk: cursors, host mirrors, and the
         auto-checkpoint all advance together at each chunk boundary, so
@@ -384,8 +402,17 @@ class StreamingAnalyticsDriver:
 
         run_scan = any(a in self.analytics
                        for a in ("degrees", "cc", "bipartite"))
+        sharded = self._engine is not None
         carry = None
-        if run_scan:
+        if run_scan and sharded:
+            # carried state straight from the engine (its layouts:
+            # deg/labels [vb+2], cover [2vb+2])
+            st = self._engine.state_dict()
+            cov0 = (st["bip_labels"] if "bip_labels" in st
+                    else np.arange(2 * vb + 2, dtype=np.int32))
+            carry = (jnp.asarray(st["degree_state"]),
+                     jnp.asarray(st["labels"]), jnp.asarray(cov0))
+        elif run_scan:
             # carried state from the host mirrors (same sources the
             # per-window path uses)
             deg0 = np.zeros(vb + 1, np.int32)
@@ -448,14 +475,35 @@ class StreamingAnalyticsDriver:
             # ---- chunk boundary: mirrors, cursors, checkpoint move
             # together. Mirror values come from the chunk's LAST
             # window row (== the carry, no extra d2h).
-            if "deg" in outs:
-                self._degrees = outs["deg"][last][:nv_chunk].astype(
-                    np.int64)
-                self._deg_state = None  # per-window path: rebuild
-            if "labels" in outs:
-                self._cc = outs["labels"][last][:nv_chunk].copy()
-            if "cover" in outs:
-                self._bip = outs["cover"][last][:2 * vb].copy()
+            if sharded and run_scan:
+                # engine.state_dict() is a full d2h sync — fetch it
+                # only for keys the scan did NOT produce (all enabled
+                # analytics come from `outs`, so usually never)
+                st = {"vb": vb}
+                cur = None
+                for key, out_key in (("degree_state", "deg"),
+                                     ("labels", "labels")):
+                    if out_key in outs:
+                        st[key] = outs[out_key][last]
+                    else:
+                        cur = cur or self._engine.state_dict()
+                        st[key] = cur[key]
+                if "cover" in outs:
+                    st["bip_labels"] = outs["cover"][last]
+                else:
+                    cur = cur or self._engine.state_dict()
+                    if "bip_labels" in cur:
+                        st["bip_labels"] = cur["bip_labels"]
+                self._engine.load_state_dict(st)
+            else:
+                if "deg" in outs:
+                    self._degrees = outs["deg"][last][:nv_chunk].astype(
+                        np.int64)
+                    self._deg_state = None  # per-window path: rebuild
+                if "labels" in outs:
+                    self._cc = outs["labels"][last][:nv_chunk].copy()
+                if "cover" in outs:
+                    self._bip = outs["cover"][last][:2 * vb].copy()
             prev_done = self.windows_done
             self.windows_done += len(chunk)
             self.edges_done += sum(

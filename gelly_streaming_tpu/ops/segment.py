@@ -228,6 +228,40 @@ def window_stack(src: np.ndarray, dst: np.ndarray, eb: int,
     return num_w, s, d, valid
 
 
+def stack_window_list(windows, eb: int, sentinel: int):
+    """Pad a list of (src, dst) window batches of varying lengths
+    (each ≤ eb) into [W, eb] stacks + validity mask — shared by the
+    single-chip and sharded count_windows batched dispatches."""
+    num_w = len(windows)
+    s = np.full((num_w, eb), sentinel, np.int32)
+    d = np.full((num_w, eb), sentinel, np.int32)
+    valid = np.zeros((num_w, eb), bool)
+    for w, (ws, wd) in enumerate(windows):
+        n = len(ws)
+        if n > eb:
+            raise ValueError(f"window of {n} edges exceeds edge "
+                             f"bucket {eb}")
+        s[w, :n] = ws
+        d[w, :n] = wd
+        valid[w, :n] = True
+    return s, d, valid
+
+
+def pad_window_chunk(s, d, valid, at: int, hi: int, max_w: int,
+                     eb: int, sentinel: int):
+    """Slice [at:hi] of a [W, eb] stack and pad the window axis to a
+    power-of-two bucket (≤ max_w) with all-invalid rows, so ragged
+    final chunks reuse O(log max_w) compiled programs. Returns
+    (s, d, valid, n) with n = the real window count."""
+    n = hi - at
+    wb = min(bucket_size(n), max_w)
+    sc = np.full((wb, eb), sentinel, np.int32)
+    dc = np.full((wb, eb), sentinel, np.int32)
+    vc = np.zeros((wb, eb), bool)
+    sc[:n], dc[:n], vc[:n] = s[at:hi], d[at:hi], valid[at:hi]
+    return sc, dc, vc, n
+
+
 # ----------------------------------------------------------------------
 # vertex interning (dense ids for device kernels)
 # ----------------------------------------------------------------------

@@ -360,12 +360,9 @@ class TriangleWindowKernel:
         counts: list = []
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            n = hi - at
-            wb = min(seg_ops.bucket_size(n), self.MAX_STREAM_WINDOWS)
-            sc = np.full((wb, self.eb), self.vb, np.int32)
-            dc = np.full((wb, self.eb), self.vb, np.int32)
-            vc = np.zeros((wb, self.eb), bool)
-            sc[:n], dc[:n], vc[:n] = s[at:hi], d[at:hi], valid[at:hi]
+            sc, dc, vc, n = seg_ops.pad_window_chunk(
+                s, d, valid, at, hi, self.MAX_STREAM_WINDOWS, self.eb,
+                self.vb)
             c, o = fn(jnp.asarray(sc), jnp.asarray(dc), jnp.asarray(vc))
             # np.array (not asarray): device outputs can be read-only
             c, o = np.array(c)[:n], np.array(o)[:n]
@@ -400,18 +397,8 @@ class TriangleWindowKernel:
         window (used by the driver's event-time windows)."""
         if not windows:
             return []
-        num_w = len(windows)
-        s = np.full((num_w, self.eb), self.vb, np.int32)
-        d = np.full((num_w, self.eb), self.vb, np.int32)
-        valid = np.zeros((num_w, self.eb), bool)
-        for w, (ws, wd) in enumerate(windows):
-            n = len(ws)
-            if n > self.eb:
-                raise ValueError(f"window of {n} edges exceeds edge "
-                                 f"bucket {self.eb}")
-            s[w, :n] = ws
-            d[w, :n] = wd
-            valid[w, :n] = True
+        s, d, valid = seg_ops.stack_window_list(windows, self.eb,
+                                                self.vb)
         return self._run_stack(s, d, valid, lambda w: windows[w])
 
 

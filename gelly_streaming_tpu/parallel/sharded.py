@@ -336,12 +336,9 @@ class ShardedTriangleWindowKernel:
         counts: list = []
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            n = hi - at
-            wb = min(seg_ops.bucket_size(n), self.MAX_STREAM_WINDOWS)
-            sc = np.full((wb, self.eb), self.vb, np.int32)
-            dc = np.full((wb, self.eb), self.vb, np.int32)
-            vc = np.zeros((wb, self.eb), bool)
-            sc[:n], dc[:n], vc[:n] = s[at:hi], d[at:hi], valid[at:hi]
+            sc, dc, vc, n = seg_ops.pad_window_chunk(
+                s, d, valid, at, hi, self.MAX_STREAM_WINDOWS, self.eb,
+                self.vb)
             args = (jax.device_put(sc, sharding),
                     jax.device_put(dc, sharding),
                     jax.device_put(vc, sharding))
@@ -382,18 +379,8 @@ class ShardedTriangleWindowKernel:
         batched event-time windows)."""
         if not windows:
             return []
-        num_w = len(windows)
-        s = np.full((num_w, self.eb), self.vb, np.int32)
-        d = np.full((num_w, self.eb), self.vb, np.int32)
-        valid = np.zeros((num_w, self.eb), bool)
-        for w, (ws, wd) in enumerate(windows):
-            n = len(ws)
-            if n > self.eb:
-                raise ValueError(f"window of {n} edges exceeds edge "
-                                 f"bucket {self.eb}")
-            s[w, :n] = ws
-            d[w, :n] = wd
-            valid[w, :n] = True
+        s, d, valid = seg_ops.stack_window_list(windows, self.eb,
+                                                self.vb)
         return self._run_stack(s, d, valid, lambda w: windows[w])
 
 
@@ -569,6 +556,72 @@ def make_sharded_summary_scan(mesh, eb: int, vb: int, kb: int, cap: int):
     )
     def run(carry, src_w, dst_w, valid_w):
         return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
+
+    return jax.jit(run)
+
+
+def make_sharded_snapshot_scan(mesh, vb: int, analytics: tuple):
+    """Sharded form of the driver's batched snapshot scan
+    (core/driver._build_snapshot_scan): lax.scan over [W, eb] window
+    stacks with the edge axis sharded over the mesh, carrying the
+    ShardedWindowEngine's state layouts — degrees [vb+2] (sentinel
+    vb+1), cc labels [vb+2], double cover [2vb+2] ((+) = v,
+    (−) = vb + v, sentinels 2vb/2vb+1) — and emitting per-window
+    replicated snapshots. Merges ride psum (degrees) and pmin (labels)
+    over ICI inside the scan, so a whole chunk of windows costs one
+    multi-chip dispatch."""
+    want_deg = "degrees" in analytics
+    want_cc = "cc" in analytics
+    want_bip = "bipartite" in analytics
+    pmin_ex = functools.partial(jax.lax.pmin, axis_name=SHARD_AXIS)
+
+    def body(carry, xs):
+        deg, labels, cover = carry
+        src, dst, valid = xs          # local shard slice [eb / n]
+        sent = vb + 1
+        s = jnp.where(valid, src, sent)
+        d = jnp.where(valid, dst, sent)
+        outs = {}
+        if want_deg:
+            ones = jnp.where(valid, 1, 0)
+            local = (jax.ops.segment_sum(ones, s, vb + 2)
+                     + jax.ops.segment_sum(ones, d, vb + 2))
+            deg = deg + jax.lax.psum(local, SHARD_AXIS)
+            outs["deg"] = deg
+        if want_cc:
+            labels = unionfind.cc_fixpoint(labels, s, d,
+                                           exchange=pmin_ex)
+            outs["labels"] = labels
+        if want_bip:
+            sent2 = 2 * vb + 1
+            s2 = jnp.concatenate([
+                jnp.where(valid, src, sent2),
+                jnp.where(valid, src + vb, sent2)])
+            d2 = jnp.concatenate([
+                jnp.where(valid, dst + vb, sent2),
+                jnp.where(valid, dst, sent2)])
+            cover = unionfind.cc_fixpoint(cover, s2, d2,
+                                          exchange=pmin_ex)
+            outs["cover"] = cover
+        return (deg, labels, cover), outs
+
+    out_tree = {}
+    if want_deg:
+        out_tree["deg"] = P()
+    if want_cc:
+        out_tree["labels"] = P()
+    if want_bip:
+        out_tree["cover"] = P()
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=((P(), P(), P()),
+                  P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+                  P(None, SHARD_AXIS)),
+        out_specs=((P(), P(), P()), out_tree),
+    )
+    def run(carry, s_w, d_w, valid_w):
+        return jax.lax.scan(body, carry, (s_w, d_w, valid_w))
 
     return jax.jit(run)
 

@@ -349,11 +349,14 @@ def test_driver_checkpoint_carries_vertex_bucket(tmp_path):
     np.testing.assert_array_equal(ra[-1].degrees, rc[-1].degrees)
 
 
-def test_batched_scan_path_matches_per_window_path():
-    """The single-chip batched snapshot-scan fast path (one dispatch
-    per call) must produce bit-identical per-window snapshots to the
-    per-window path (one-window calls), including mid-call vertex
-    growth, for both count-based and event-time windows."""
+@pytest.mark.parametrize("sharded", [False, True])
+def test_batched_scan_path_matches_per_window_path(sharded):
+    """The batched snapshot-scan fast path (one dispatch per call,
+    single-chip jit or shard_map over the mesh) must produce
+    bit-identical per-window snapshots to the per-window path
+    (one-window calls), including mid-call vertex growth, for both
+    count-based and event-time windows."""
+    mesh = make_mesh() if sharded else None
     rng = np.random.default_rng(17)
     n, eb = 1024, 128
     # growing vertex domain forces bucket doubling inside the call
@@ -365,9 +368,9 @@ def test_batched_scan_path_matches_per_window_path():
 
     for mode in ("count", "event"):
         a = StreamingAnalyticsDriver(window_ms=1000, edge_bucket=eb,
-                                     vertex_bucket=16)
+                                     vertex_bucket=16, mesh=mesh)
         b = StreamingAnalyticsDriver(window_ms=1000, edge_bucket=eb,
-                                     vertex_bucket=16)
+                                     vertex_bucket=16, mesh=mesh)
         if mode == "count":
             batched = a.run_arrays(src, dst)
             single = []
