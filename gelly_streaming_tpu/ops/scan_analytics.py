@@ -131,8 +131,13 @@ class SummaryEngineBase:
         out = []
         for at in range(0, num_w, self.MAX_WINDOWS):
             hi = min(at + self.MAX_WINDOWS, num_w)
-            mdeg, ncomp, odd, tri, b_ovf, k_ovf = self._dispatch(
-                s[at:hi], d[at:hi], valid[at:hi])
+            # ragged tails pad the window axis to a power-of-two bucket
+            # (all-invalid rows fold as no-ops against the carry), so
+            # varying stream lengths reuse O(log MAX_WINDOWS) programs
+            sc, dc, vc, real = seg_ops.pad_window_chunk(
+                s, d, valid, at, hi, self.MAX_WINDOWS, self.eb, self.vb)
+            mdeg, ncomp, odd, tri, b_ovf, k_ovf = (
+                x[:real] for x in self._dispatch(sc, dc, vc))
             for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
                 lo = (at + int(w)) * self.eb
                 tri[w] = self._redo(src[lo:lo + self.eb],

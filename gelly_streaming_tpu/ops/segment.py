@@ -255,6 +255,8 @@ def pad_window_chunk(s, d, valid, at: int, hi: int, max_w: int,
     (s, d, valid, n) with n = the real window count."""
     n = hi - at
     wb = min(bucket_size(n), max_w)
+    if n == wb:  # full chunk (the steady state): zero-copy views
+        return s[at:hi], d[at:hi], valid[at:hi], n
     sc = np.full((wb, eb), sentinel, np.int32)
     dc = np.full((wb, eb), sentinel, np.int32)
     vc = np.zeros((wb, eb), bool)

@@ -198,6 +198,56 @@ def test_escalation_ladder_widens_to_kmax():
     assert all(b > a for a, b in zip(ladder, ladder[1:]))
 
 
+def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
+    """triangle_count's dense path comes from committed PERF.json
+    on-chip measurements: XLA by default (and always off-TPU), Pallas
+    only when the measurements were taken on a TPU and every measured
+    V shows parity-checked speedup ≥1.05."""
+    import json
+    import sys
+
+    # off-TPU (this CI): always XLA at the standard limit
+    tri_ops._DENSE_CHOICE = None
+    assert tri_ops._resolve_dense_choice() == ("xla", tri_ops.DENSE_LIMIT)
+
+    # fake a TPU backend + measurements in an isolated file
+    class _FakeJax:
+        @staticmethod
+        def default_backend():
+            return "tpu"
+
+    perf_path = str(tmp_path / "PERF.json")
+    monkeypatch.setattr(tri_ops, "_PERF_PATH", perf_path)
+    monkeypatch.setitem(sys.modules, "jax", _FakeJax)
+    try:
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "tpu",
+                       "dense": [{"v": 1024, "pallas_speedup": 1.4},
+                                 {"v": 2048, "pallas_speedup": 1.2}]}, f)
+        tri_ops._DENSE_CHOICE = None
+        assert tri_ops._resolve_dense_choice() == (
+            "pallas", 2 * tri_ops.DENSE_LIMIT)
+
+        # one losing size vetoes the switch
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "tpu",
+                       "dense": [{"v": 1024, "pallas_speedup": 1.4},
+                                 {"v": 2048, "pallas_speedup": 0.9}]}, f)
+        tri_ops._DENSE_CHOICE = None
+        assert tri_ops._resolve_dense_choice() == (
+            "xla", tri_ops.DENSE_LIMIT)
+
+        # measurements recorded on a CPU backend never flip the default
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "cpu",
+                       "dense": [{"v": 1024, "pallas_speedup": 9.9}]}, f)
+        tri_ops._DENSE_CHOICE = None
+        assert tri_ops._resolve_dense_choice() == (
+            "xla", tri_ops.DENSE_LIMIT)
+    finally:
+        tri_ops._DENSE_CHOICE = None
+
+
 def test_kernels_empty_and_tiny():
     assert tri_ops.triangle_count_sparse(np.array([]), np.array([]), 0) == 0
     assert tri_ops.triangle_count_dense(np.array([0]), np.array([1]), 2) == 0
