@@ -122,7 +122,57 @@ def intersect_local(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
     return total
 
 
-_intersect_count = jax.jit(intersect_local)
+_INTERSECT_CHOICE = None   # resolved once per process
+_INTERSECT_JIT = None      # jitted form of the choice, built once
+
+
+def _load_tpu_perf():
+    """Parsed PERF.json iff this process runs a TPU backend AND the
+    committed measurements were recorded on one; None otherwise.
+    Shared scaffolding of the measurement-driven kernel selections."""
+    import json
+
+    try:
+        import jax as _jax
+
+        if _jax.default_backend() != "tpu":
+            return None
+        with open(_PERF_PATH) as f:
+            perf = json.load(f)
+        return perf if perf.get("backend") == "tpu" else None
+    except Exception:
+        return None
+
+
+def resolve_intersect_impl():
+    """The intersection kernel actually built into the window-counter
+    programs: the XLA chunked broadcast compare by default, the Pallas
+    fused-tile variant (ops/pallas_intersect.py) only when committed
+    TPU measurements (PERF.json `intersect` section) show it at parity
+    and ≥5% faster — same selection policy as the dense path."""
+    global _INTERSECT_CHOICE
+    if _INTERSECT_CHOICE is not None:
+        return _INTERSECT_CHOICE
+    impl = intersect_local
+    perf = _load_tpu_perf()
+    if perf is not None:
+        row = perf.get("intersect", {})
+        if (row.get("parity_pallas") is True
+                and (row.get("pallas_vs_xla_compare") or 0) >= 1.05):
+            from .pallas_intersect import intersect_local_pallas
+
+            impl = intersect_local_pallas
+    _INTERSECT_CHOICE = impl
+    return impl
+
+
+def _intersect_jit():
+    """Once-per-process jitted wrapper of the resolved intersect
+    kernel (the standalone form triangle_count_sparse dispatches)."""
+    global _INTERSECT_JIT
+    if _INTERSECT_JIT is None:
+        _INTERSECT_JIT = jax.jit(resolve_intersect_impl())
+    return _INTERSECT_JIT
 
 
 def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,
@@ -159,7 +209,7 @@ def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,
     nbr = np.full((vb + 1, max_out), vb, np.int32)
     nbr[a, np.arange(e) - starts[a]] = b  # ascending within each row
     ep = seg_ops.bucket_size(e)
-    count = _intersect_count(
+    count = _intersect_jit()(
         jnp.asarray(nbr),
         jnp.asarray(seg_ops.pad_to(a, ep, fill=vb)),
         jnp.asarray(seg_ops.pad_to(b, ep, fill=vb)),
@@ -215,6 +265,7 @@ def build_window_counter(vb: int, kb: int):
     analytics scan (ops/scan_analytics.py), which inlines it in a scan
     body."""
     sent = vb  # sentinel vertex id: sorts last, row vb is the pad row
+    intersect = resolve_intersect_impl()  # measured choice, build time
 
     def run(src, dst, valid):
         # ---- clean: drop self-loops and padding
@@ -243,8 +294,8 @@ def build_window_counter(vb: int, kb: int):
 
         # ---- neighbor-row intersection at each oriented edge
         emask = a < sent
-        count = intersect_local(nbr, a.astype(jnp.int32),
-                                b.astype(jnp.int32), emask)
+        count = intersect(nbr, a.astype(jnp.int32),
+                          b.astype(jnp.int32), emask)
         return count, overflow
 
     return run
@@ -424,23 +475,14 @@ def _resolve_dense_choice():
     global _DENSE_CHOICE
     if _DENSE_CHOICE is not None:
         return _DENSE_CHOICE
-    import json
-
     choice = ("xla", DENSE_LIMIT)
-    try:
-        import jax
-
-        if jax.default_backend() == "tpu":
-            with open(_PERF_PATH) as f:
-                perf = json.load(f)
-            rows = perf.get("dense", [])
-            if (perf.get("backend") == "tpu"
-                    and isinstance(rows, list) and rows
-                    and all(r.get("pallas_speedup", 0) >= 1.05
-                            for r in rows)):
-                choice = ("pallas", 2 * DENSE_LIMIT)
-    except Exception:
-        pass
+    perf = _load_tpu_perf()
+    if perf is not None:
+        rows = perf.get("dense", [])
+        if (isinstance(rows, list) and rows
+                and all(r.get("pallas_speedup", 0) >= 1.05
+                        for r in rows)):
+            choice = ("pallas", 2 * DENSE_LIMIT)
     _DENSE_CHOICE = choice
     return choice
 

@@ -101,13 +101,15 @@ def make_sharded_triangle_fn(mesh):
     edge list sharded across chips and the sorted-adjacency matrix
     replicated; per-shard intersection partials reduce with one psum."""
 
+    intersect = triangles.resolve_intersect_impl()
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=P(),
     )
     def step(nbr, ea, eb, emask):
-        local = triangles.intersect_local(nbr, ea, eb, emask)
+        local = intersect(nbr, ea, eb, emask)
         return jax.lax.psum(local, SHARD_AXIS)
 
     return jax.jit(step)
@@ -157,6 +159,7 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
     assert eb % n == 0 and kb % n == 0, (eb, kb, n)
     sent = vb
     kslice = kb // n
+    intersect = triangles.resolve_intersect_impl()
 
     def step(src, dst, valid):
         me = jax.lax.axis_index(axis)
@@ -222,7 +225,7 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
         nbr = jnp.where(nbr < 0, sent, nbr)
 
         # ---- each shard intersects the edges it owns; psum the partials
-        local = triangles.intersect_local(nbr, ra, rb, ra < sent)
+        local = intersect(nbr, ra, rb, ra < sent)
         count = jax.lax.psum(local, axis)
         # separate signals so the host widens only the dimension that
         # overflowed (cap vs K): each (kb, cap) pair is a fresh compile

@@ -206,6 +206,11 @@ def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
     import json
     import sys
 
+    # import BEFORE jax is monkeypatched: pallas_intersect's own
+    # module-level jax imports must resolve against the real jax
+    from gelly_streaming_tpu.ops.pallas_intersect import \
+        intersect_local_pallas
+
     # off-TPU (this CI): always XLA at the standard limit
     tri_ops._DENSE_CHOICE = None
     assert tri_ops._resolve_dense_choice() == ("xla", tri_ops.DENSE_LIMIT)
@@ -244,8 +249,24 @@ def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
         tri_ops._DENSE_CHOICE = None
         assert tri_ops._resolve_dense_choice() == (
             "xla", tri_ops.DENSE_LIMIT)
+
+        # intersect selection: same policy (parity + >=1.05 on tpu)
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "tpu",
+                       "intersect": {"parity_pallas": True,
+                                     "pallas_vs_xla_compare": 1.3}}, f)
+        tri_ops._INTERSECT_CHOICE = None
+        assert tri_ops.resolve_intersect_impl() is intersect_local_pallas
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "tpu",
+                       "intersect": {"parity_pallas": True,
+                                     "pallas_vs_xla_compare": 0.8}}, f)
+        tri_ops._INTERSECT_CHOICE = None
+        assert tri_ops.resolve_intersect_impl() is tri_ops.intersect_local
     finally:
         tri_ops._DENSE_CHOICE = None
+        tri_ops._INTERSECT_CHOICE = None
+        tri_ops._INTERSECT_JIT = None
 
 
 def test_kernels_empty_and_tiny():
