@@ -101,15 +101,17 @@ def make_sharded_triangle_fn(mesh):
     edge list sharded across chips and the sorted-adjacency matrix
     replicated; per-shard intersection partials reduce with one psum."""
 
-    intersect = triangles.resolve_intersect_impl()
-
+    # NOT resolve_intersect_impl(): pl.pallas_call inside shard_map
+    # fails jax 0.9's check_vma at trace time (vma=None on the
+    # out_shape), so sharded bodies pin the XLA compare regardless of
+    # the single-chip measurement-driven choice
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=P(),
     )
     def step(nbr, ea, eb, emask):
-        local = intersect(nbr, ea, eb, emask)
+        local = triangles.intersect_local(nbr, ea, eb, emask)
         return jax.lax.psum(local, SHARD_AXIS)
 
     return jax.jit(step)
@@ -159,7 +161,9 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
     assert eb % n == 0 and kb % n == 0, (eb, kb, n)
     sent = vb
     kslice = kb // n
-    intersect = triangles.resolve_intersect_impl()
+    # XLA compare, NOT the measured single-chip choice: pallas_call in
+    # shard_map trips check_vma (see make_sharded_triangle_fn)
+    intersect = triangles.intersect_local
 
     def step(src, dst, valid):
         me = jax.lax.axis_index(axis)

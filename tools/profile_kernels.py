@@ -168,6 +168,40 @@ def section_fused(results: dict) -> None:
     results["fused"] = out
 
 
+def section_driver(results: dict) -> None:
+    """StreamingAnalyticsDriver end-to-end: the batched fast path (one
+    snapshot-scan dispatch + one triangle stack dispatch per 64-window
+    chunk) vs the per-window dispatch path on the same stream — the
+    dispatch-economics win this round's driver work targets."""
+    from gelly_streaming_tpu import StreamingAnalyticsDriver
+
+    eb, num_w = 8_192, 32
+    vb = 2 * eb
+    src, dst = _stream(num_w * eb, vb)
+    out = {}
+    for mode in ("batched", "per-window"):
+        drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=eb,
+                                       vertex_bucket=vb)
+
+        def run():
+            drv.reset()
+            if mode == "batched":
+                drv.run_arrays(src, dst)
+            else:
+                for i in range(0, len(src), eb):
+                    drv.run_arrays(src[i:i + eb], dst[i:i + eb])
+
+        t = _timeit(run, reps=3, warmup=1)
+        out[mode] = {"per_window_ms": round(t / num_w * 1e3, 3),
+                     "edges_per_s": round(num_w * eb / t)}
+    out["speedup"] = round(
+        out["per-window"]["per_window_ms"]
+        / out["batched"]["per_window_ms"], 2)
+    out["edge_bucket"] = eb
+    out["windows"] = num_w
+    results["driver"] = out
+
+
 def section_dense(results: dict) -> None:
     """Dense triangle path: XLA matmul (A@A ⊙ A row sums) vs the Pallas
     fused contraction, V = 1024/2048/4096. The winner (on the chip)
@@ -280,7 +314,7 @@ print(json.dumps(out))
 
 def main():
     want = set(sys.argv[1:]) or {"intersect", "window", "fused", "dense",
-                                 "sharded"}
+                                 "driver", "sharded"}
     results = {}
 
     if want - {"sharded"}:
@@ -300,6 +334,9 @@ def main():
     if "dense" in want:
         section_dense(results)
         print(json.dumps({"dense": results["dense"]}), flush=True)
+    if "driver" in want:
+        section_driver(results)
+        print(json.dumps({"driver": results["driver"]}), flush=True)
     if "sharded" in want:
         results["sharded"] = section_sharded(REPO)
         print(json.dumps({"sharded": results["sharded"]}), flush=True)
