@@ -120,25 +120,57 @@ def section_intersect(results: dict) -> None:
 
 def section_window(results: dict) -> None:
     """TriangleWindowKernel.count_stream: per-window latency and h2d
-    bandwidth at three window sizes (64 windows each)."""
+    bandwidth at three window sizes (64 windows each). The K×K
+    intersection compare dominates and shrinks quadratically with the
+    K bucket, so each size also sweeps K below the default — a smaller
+    K wins whenever the stream's max oriented out-degree stays under
+    it (overflowing windows pay an exact per-window recount, counted
+    here)."""
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
+    # 8K/32K compile in seconds on the tunnel; the 131072-edge-window
+    # program stalled its remote compiler >30 min and wedged it for
+    # hours (see bench.py's window cap). Extend via GS_PROFILE_BIG=1
+    # only when babysitting the run.
+    sizes = (8_192, 32_768)
+    if os.environ.get("GS_PROFILE_BIG") == "1":
+        sizes = sizes + (131_072,)
     out = []
-    for eb in (8_192, 32_768, 131_072):
+    for eb in sizes:
         vb = 2 * eb
         num_w = 64
         src, dst = _stream(num_w * eb, vb)
-        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
-        t = _timeit(lambda: kern.count_stream(src, dst), reps=3, warmup=1)
-        per_window_ms = t / num_w * 1e3
-        edges_per_s = num_w * eb / t
-        h2d_mb = num_w * eb * 2 * 4 / 1e6  # src+dst int32
-        out.append({
-            "edge_bucket": eb, "k_bucket": kern.kb, "windows": num_w,
-            "per_window_ms": round(per_window_ms, 3),
-            "edges_per_s": round(edges_per_s),
-            "h2d_mb_per_chunk": round(h2d_mb, 1),
-        })
+        row = {"edge_bucket": eb, "windows": num_w,
+               "h2d_mb_per_chunk": round(num_w * eb * 2 * 4 / 1e6, 1),
+               "k_sweep": []}
+        default_kb = TriangleWindowKernel(
+            edge_bucket=eb, vertex_bucket=vb).kb
+        for kb in sorted({default_kb, default_kb // 2, default_kb // 4}):
+            kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                        k_bucket=kb)
+            # one instrumented pass counts the overflow recounts an
+            # undersized K pays (and warms every program it needs),
+            # then the clean timing runs uninstrumented
+            overflows = [0]
+            orig = kern.count
+
+            def counting(s, d, min_k=0):
+                overflows[0] += 1
+                return orig(s, d, min_k)
+
+            kern.count = counting
+            kern.count_stream(src, dst)
+            kern.count = orig
+            t = _timeit(lambda: kern.count_stream(src, dst),
+                        reps=3, warmup=0)
+            row["k_sweep"].append({
+                "k_bucket": kern.kb,
+                "default": kern.kb == default_kb,
+                "per_window_ms": round(t / num_w * 1e3, 3),
+                "edges_per_s": round(num_w * eb / t),
+                "overflow_recounts_per_run": overflows[0],
+            })
+        out.append(row)
     results["window"] = out
 
 
