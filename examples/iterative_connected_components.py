@@ -16,7 +16,7 @@ import _bootstrap  # noqa: F401  (repo path + --cpu flag handling)
 
 import numpy as np
 
-from gelly_streaming_tpu import Edge, StreamEnvironment
+from gelly_streaming_tpu import StreamEnvironment
 from gelly_streaming_tpu.models.iterative_cc import (
     TpuIterativeConnectedComponents, iterative_connected_components)
 

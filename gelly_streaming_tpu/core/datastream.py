@@ -11,7 +11,7 @@ These are thin lazy wrappers over `plan.OpNode`; the runtime executes them.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+from typing import Any, Callable, Optional
 
 from .gtime import Time
 from .plan import KeySpec, OpNode

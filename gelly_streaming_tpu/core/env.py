@@ -8,7 +8,7 @@ program context, sources, time characteristic, parallelism, execute().
 
 from __future__ import annotations
 
-from typing import Any, Iterable, List, Optional, Sequence
+from typing import Any, Iterable, List, Optional
 
 from .datastream import DataStream
 from .gtime import Clock, SystemClock, TimeCharacteristic

@@ -23,12 +23,10 @@ estimates are reproducible — unlike the reference's Math.random() third
 
 from __future__ import annotations
 
-from typing import List
 
 import numpy as np
 
 from ..core.datastream import DataStream
-from ..core.plan import OpNode
 from ..core.types import Edge
 from ..utils.events import SampledEdge, TriangleEstimate
 

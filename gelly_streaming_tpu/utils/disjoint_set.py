@@ -15,7 +15,7 @@ order for determinism.
 
 from __future__ import annotations
 
-from typing import Dict, Generic, Iterable, Tuple, TypeVar
+from typing import Dict, Generic, Iterable, TypeVar
 
 R = TypeVar("R")
 

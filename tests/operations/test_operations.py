@@ -7,7 +7,7 @@ chained), filterEdges/filterVertices (simple/keep-all/discard-all),
 distinct, reverse, undirected, union.
 """
 
-from gelly_streaming_tpu import Edge, SimpleEdgeStream
+from gelly_streaming_tpu import SimpleEdgeStream
 
 from ..conftest import long_long_edges, run_and_sort
 

@@ -3,7 +3,7 @@ items — all absent from the reference)."""
 
 import numpy as np
 
-from gelly_streaming_tpu import Edge, NULL, SimpleEdgeStream
+from gelly_streaming_tpu import SimpleEdgeStream
 from gelly_streaming_tpu.models.iterative_cc import \
     TpuIterativeConnectedComponents
 from gelly_streaming_tpu.utils import checkpoint
