@@ -305,6 +305,35 @@ def build_window_counter(vb: int, kb: int):
 # streaming fixed-shape engine: the whole window pipeline on device
 # ----------------------------------------------------------------------
 
+_TUNED_KB = {}  # eb -> measured starting K (resolved once per process)
+
+
+def _tuned_kb(eb: int) -> int:
+    """Initial K bucket for an edge-bucket size. The K×K intersection
+    compare dominates per-window cost and shrinks quadratically with
+    K, so the default comes from the committed k-sweep measurements
+    (PERF.json `window` section, tools/profile_kernels.py) when they
+    exist for this bucket on this hardware: the fastest measured K
+    whose run needed no overflow recounts. The escalation ladder
+    guarantees exactness regardless, so an undersized start only costs
+    the rare recount. Fallback: the analytic O(√E) heuristic."""
+    if eb in _TUNED_KB:
+        return _TUNED_KB[eb]
+    kb = min(128, 2 * int(np.sqrt(eb)))
+    perf = _load_tpu_perf()
+    if perf is not None:
+        for row in perf.get("window", []):
+            if row.get("edge_bucket") != eb:
+                continue
+            clean = [s for s in row.get("k_sweep", [])
+                     if s.get("overflow_recounts_per_run") == 0
+                     and s.get("per_window_ms")]
+            if clean:
+                kb = min(clean, key=lambda s: s["per_window_ms"])[
+                    "k_bucket"]
+    _TUNED_KB[eb] = kb
+    return kb
+
 class TriangleWindowKernel:
     """One compiled program for an unbounded stream of windows.
 
@@ -344,8 +373,8 @@ class TriangleWindowKernel:
                  k_bucket: int = 0):
         self.eb = seg_ops.bucket_size(edge_bucket)
         self.vb = seg_ops.bucket_size(vertex_bucket)
-        self.kb = seg_ops.bucket_size(k_bucket if k_bucket else
-                                      min(128, 2 * int(np.sqrt(self.eb))))
+        self.kb = seg_ops.bucket_size(
+            k_bucket if k_bucket else _tuned_kb(self.eb))
         self.kb_max = seg_ops.bucket_size(2 * int(np.sqrt(self.eb)))
         self._fns = {self.kb: self._build(self.kb)}
         self._stream_fns = {}

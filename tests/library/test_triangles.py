@@ -263,10 +263,26 @@ def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
                                      "pallas_vs_xla_compare": 0.8}}, f)
         tri_ops._INTERSECT_CHOICE = None
         assert tri_ops.resolve_intersect_impl() is tri_ops.intersect_local
+        # tuned K: fastest zero-overflow sweep entry wins; rows with
+        # recounts or other edge buckets are ignored
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "tpu", "window": [
+                {"edge_bucket": 4096, "k_sweep": [
+                    {"k_bucket": 32, "per_window_ms": 2.0,
+                     "overflow_recounts_per_run": 0},
+                    {"k_bucket": 64, "per_window_ms": 5.0,
+                     "overflow_recounts_per_run": 0},
+                    {"k_bucket": 16, "per_window_ms": 1.0,
+                     "overflow_recounts_per_run": 3}]}]}, f)
+        tri_ops._TUNED_KB.clear()
+        assert tri_ops._tuned_kb(4096) == 32
+        assert tri_ops._tuned_kb(8192) == min(
+            128, 2 * int(np.sqrt(8192)))  # unmeasured bucket: heuristic
     finally:
         tri_ops._DENSE_CHOICE = None
         tri_ops._INTERSECT_CHOICE = None
         tri_ops._INTERSECT_JIT = None
+        tri_ops._TUNED_KB.clear()
 
 
 def test_kernels_empty_and_tiny():

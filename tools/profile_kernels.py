@@ -143,8 +143,11 @@ def section_window(results: dict) -> None:
         row = {"edge_bucket": eb, "windows": num_w,
                "h2d_mb_per_chunk": round(num_w * eb * 2 * 4 / 1e6, 1),
                "k_sweep": []}
-        default_kb = TriangleWindowKernel(
-            edge_bucket=eb, vertex_bucket=vb).kb
+        # anchor the sweep on the ANALYTIC heuristic, never the tuned
+        # value a committed PERF.json may already inject into the
+        # kernel default — otherwise successive profiling runs ratchet
+        # K downward and can never re-explore larger values
+        default_kb = min(128, 2 * int(np.sqrt(eb)))
         for kb in sorted({default_kb, default_kb // 2, default_kb // 4}):
             kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
                                         k_bucket=kb)
