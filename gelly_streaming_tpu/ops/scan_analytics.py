@@ -163,8 +163,8 @@ class StreamSummaryEngine(SummaryEngineBase):
                  k_bucket: int = 0):
         self.eb = seg_ops.bucket_size(edge_bucket)
         self.vb = seg_ops.bucket_size(vertex_bucket)
-        self.kb = seg_ops.bucket_size(k_bucket if k_bucket else
-                                      min(128, 2 * int(np.sqrt(self.eb))))
+        self.kb = seg_ops.bucket_size(
+            k_bucket if k_bucket else tri_ops._tuned_kb(self.eb))
         body = _build_scan(self.eb, self.vb, self.kb)
 
         @jax.jit

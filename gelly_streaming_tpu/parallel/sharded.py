@@ -256,7 +256,9 @@ class ShardedTriangleWindowKernel:
 
         self.eb = _mult_of_n(seg_ops.bucket_size(edge_bucket))
         self.vb = seg_ops.bucket_size(vertex_bucket)
-        kb0 = k_bucket if k_bucket else min(128, 2 * int(np.sqrt(self.eb)))
+        # same measured starting K as the single-chip kernel (rounded
+        # to a shard multiple); escalation still guards exactness
+        kb0 = k_bucket if k_bucket else triangles._tuned_kb(self.eb)
         self.kb = _mult_of_n(seg_ops.bucket_size(kb0))
         self.kb_max = max(
             _mult_of_n(seg_ops.bucket_size(2 * int(np.sqrt(self.eb)))),
