@@ -212,10 +212,13 @@ class StreamingAnalyticsDriver:
         src, dst, ts = native.parse_edge_file(path)
         return self.run_arrays(src, dst, ts)
 
-    def stream_file(self, path: str, chunk_bytes: int = 1 << 24,
+    def stream_file(self, path: str, chunk_bytes: int = 1 << 26,
                     resume: bool = False):
         """Generator over WindowResults for an arbitrarily large file,
         in bounded memory: the file is parsed in `chunk_bytes` pieces
+        (default 64MB ≈ tens of windows per piece, so the batched
+        fast path gets full dispatch batches; prefetched ahead in a
+        producer thread)
         (io/sources.iter_edge_chunks) and the still-open final window
         of each piece is held back until the next piece closes it —
         tumbling windows never split at chunk boundaries.
