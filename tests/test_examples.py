@@ -1,0 +1,64 @@
+"""Example-CLI smoke tests: every reference workload has a CLI twin
+under examples/ (SURVEY.md §2.3); these pin the entry points' argument
+surface and end-to-end output on a tiny graph, in hermetic CPU
+subprocesses (the CLIs pick their own backend; tests must not touch
+the real chip)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+EDGES = "1 2 100\n1 3 150\n3 2 200\n2 4 250\n3 4 300\n4 5 400\n"
+
+
+def _run(args, timeout=240):
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable] + args, cwd=REPO, env=env,
+                          capture_output=True, text=True,
+                          timeout=timeout)
+
+
+@pytest.fixture(scope="module")
+def edge_file(tmp_path_factory):
+    p = tmp_path_factory.mktemp("cli") / "edges.txt"
+    p.write_text(EDGES)
+    return str(p)
+
+
+def test_window_triangles_cli(edge_file, tmp_path):
+    out = str(tmp_path / "tri.txt")
+    r = _run(["examples/window_triangles.py", edge_file, out, "200"])
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = sorted(open(out).read().split())
+    # triangle {2,3,4} completes in the 200-399 window
+    assert "(1,399)" in lines
+
+
+def test_connected_components_cli(edge_file, tmp_path):
+    out = str(tmp_path / "cc.txt")
+    r = _run(["examples/connected_components.py", edge_file, out, "100"])
+    assert r.returncode == 0, r.stderr[-500:]
+    text = open(out).read()
+    assert text.strip(), "no component output"
+
+
+def test_bipartiteness_cli(edge_file, tmp_path):
+    out = str(tmp_path / "bip.txt")
+    r = _run(["examples/bipartiteness_check.py", edge_file, out, "100"])
+    assert r.returncode == 0, r.stderr[-500:]
+    text = open(out).read()
+    # the graph has triangles -> odd cycle -> not bipartite at the end
+    assert "false" in text.lower()
+
+
+def test_measurements_cli_degrees(edge_file):
+    r = _run(["examples/measurements.py", "degrees", edge_file, "8"])
+    assert r.returncode == 0, r.stderr[-500:]
+    import json
+
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["workload"] == "degrees" and row["edges"] == 6
