@@ -53,6 +53,36 @@ def _is_backend_drop(e: Exception) -> bool:
     return "UNAVAILABLE" in s or "Unable to initialize backend" in s
 
 
+def run_with_hard_timeout(argv, timeout_s: int, env=None):
+    """Run argv in its own process GROUP with a hard timeout; returns
+    (rc, stdout, stderr) with rc=None on timeout. Output goes to temp
+    FILES, not pipes, and the child gets its own session: if the PJRT
+    plugin forks a helper that inherits the descriptors, a pipe would
+    keep a post-kill communicate() stuck forever; a file EOFs
+    regardless, and killpg reaps the helper. Shared by probe_backend
+    and tools/profile_kernels.py's section runner (the per-scale bench
+    runs keep their own Popen because they stream stdout live)."""
+    import signal
+    import tempfile
+
+    with tempfile.TemporaryFile("w+") as out, \
+            tempfile.TemporaryFile("w+") as err:
+        p = subprocess.Popen(argv, stdout=out, stderr=err, text=True,
+                             env=env, start_new_session=True)
+        try:
+            rc = p.wait(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            rc = None
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            p.wait()
+        out.seek(0)
+        err.seek(0)
+        return rc, out.read(), err.read()
+
+
 def probe_backend(attempts: int = None, timeout_s: int = None,
                   backoff_s: int = 20):
     """Check in a SUBPROCESS (with a hard timeout) that jax can bring up
@@ -68,35 +98,14 @@ def probe_backend(attempts: int = None, timeout_s: int = None,
         attempts = int(os.environ.get("GS_BENCH_PROBE_ATTEMPTS", "3"))
     if timeout_s is None:
         timeout_s = int(os.environ.get("GS_BENCH_PROBE_TIMEOUT", "120"))
-    import signal
-    import tempfile
 
     code = "import jax; d=jax.devices(); print(d[0].platform)"
     for i in range(attempts):
         # Escalate the timeout per attempt so a slow-but-healthy init is
         # distinguished from a hang (120s, 240s, 360s by default).
         t = timeout_s * (i + 1)
-        # Output goes to temp FILES, not pipes, and the child gets its
-        # own session: if the plugin forks a helper that inherits the
-        # descriptors, a pipe would keep a post-kill communicate() stuck
-        # forever; a file EOFs regardless, and killpg reaps the helper.
-        with tempfile.TemporaryFile("w+") as out, \
-                tempfile.TemporaryFile("w+") as err:
-            p = subprocess.Popen([sys.executable, "-c", code],
-                                 stdout=out, stderr=err, text=True,
-                                 start_new_session=True)
-            try:
-                rc = p.wait(timeout=t)
-            except subprocess.TimeoutExpired:
-                rc = None
-                try:
-                    os.killpg(p.pid, signal.SIGKILL)
-                except OSError:
-                    pass
-                p.wait()
-            out.seek(0)
-            err.seek(0)
-            stdout, stderr = out.read(), err.read()
+        rc, stdout, stderr = run_with_hard_timeout(
+            [sys.executable, "-c", code], t)
         if rc == 0 and stdout.strip():
             platform = stdout.strip().splitlines()[-1]
             print("backend probe ok: %s" % platform, file=sys.stderr)

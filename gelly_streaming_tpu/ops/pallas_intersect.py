@@ -33,14 +33,22 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_triangles import _need_interpret
 
-TILE_E = 256     # edges per grid step
+TILE_E = 64      # edges per grid step: the [T, CHUNK_K, K] broadcast
+                 # compare materializes in VMEM, so T=64/Ck=128/K<=256
+                 # stays under the 16M scoped-vmem limit (T=256 OOMs)
 CHUNK_K = 128    # compare-chunk width (lane-aligned)
+MAX_TILES = 2048 # grid steps per pallas_call: the [g] partial vector
+                 # lives wholly in SMEM (scarce scalar memory), so cap
+                 # it at 8KB and slab larger edge buckets over several
+                 # calls (each slab shape is identical -> one compile)
 
 
 def _intersect_kernel(ra, rb, va, out):
     """ra/rb: [TILE_E, K] int32 neighbor rows; va: [TILE_E, K] bool
-    validity of ra entries (sentinel/padding pre-masked). out: [1]
-    int32 partial count for this tile."""
+    validity of ra entries (sentinel/padding pre-masked). out: [g]
+    int32 partial counts in SMEM — the whole array is the block (a
+    size-1 block per step is not lowerable on TPU), each grid step
+    writes its own slot."""
     k = ra.shape[1]
     rb_val = rb[:]                                # [T, K] in VMEM
     total = jnp.int32(0)
@@ -52,7 +60,7 @@ def _intersect_kernel(ra, rb, va, out):
             a_chunk[:, :, None] == rb_val[:, None, :], axis=2)  # [T, Ck]
         total += jnp.sum(jnp.where(hit & v_chunk, 1, 0),
                          dtype=jnp.int32)
-    out[0] = total
+    out[pl.program_id(0)] = total
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -72,8 +80,13 @@ def _intersect_tiles(rows_a: jax.Array, rows_b: jax.Array,
             pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1,), lambda i: (i,),
-                               memory_space=pltpu.VMEM),
+        # One scalar per grid step. A PER-STEP size-1 output block
+        # ((1,) block over a (g,) array, g>1) is not lowerable on TPU
+        # in any memory space; a block whose size EQUALS the array dim
+        # is always legal (this also covers g==1). So expose the whole
+        # [g] vector as one SMEM block and index by program_id.
+        out_specs=pl.BlockSpec((g,), lambda i: (0,),
+                               memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((g,), jnp.int32),
         interpret=interpret,
     )(rows_a, rows_b, valid)
@@ -85,13 +98,18 @@ def intersect_local_pallas(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
     of |N_out(a) ∩ N_out(b)| over all valid oriented edges)."""
     sentinel = nbr.shape[0] - 1
     ep = ea.shape[0]
-    pad = (-ep) % TILE_E
+    slab_e = MAX_TILES * TILE_E
+    pad = (-ep) % (TILE_E if ep <= slab_e else slab_e)
     if pad:
         ea = jnp.concatenate([ea, jnp.full(pad, sentinel, ea.dtype)])
         eb = jnp.concatenate([eb, jnp.full(pad, sentinel, eb.dtype)])
         emask = jnp.concatenate([emask, jnp.zeros(pad, emask.dtype)])
-    rows_a = nbr[ea]
-    rows_b = nbr[eb]
-    valid = (rows_a < sentinel) & emask[:, None]
-    partials = _intersect_tiles(rows_a, rows_b, valid, _need_interpret())
-    return jnp.sum(partials, dtype=jnp.int32)
+    interpret = _need_interpret()
+    total = jnp.int32(0)
+    for s in range(0, ea.shape[0], slab_e):
+        rows_a = nbr[ea[s:s + slab_e]]
+        rows_b = nbr[eb[s:s + slab_e]]
+        valid = (rows_a < sentinel) & emask[s:s + slab_e, None]
+        partials = _intersect_tiles(rows_a, rows_b, valid, interpret)
+        total = total + jnp.sum(partials, dtype=jnp.int32)
+    return total

@@ -102,9 +102,9 @@ def test_pallas_intersect_matches_xla_compare(seed):
         intersect_local_pallas
 
     rng = np.random.default_rng(seed)
-    # shapes chosen to exercise EVERY kernel dimension: ep=600 → three
-    # TILE_E=256 grid tiles (ragged final tile via padding), k=160 →
-    # two CHUNK_K=128 compare chunks (ragged final chunk of 32)
+    # shapes chosen to exercise EVERY kernel dimension: ep=600 → ten
+    # TILE_E=64 grid tiles (ragged final tile of 24 via padding),
+    # k=160 → two CHUNK_K=128 compare chunks (ragged final chunk of 32)
     vb, k, ep = 64, 160, 600
     fill = rng.integers(0, vb, size=(vb + 1, k)).astype(np.int32)
     fill.sort(axis=1)
@@ -117,6 +117,32 @@ def test_pallas_intersect_matches_xla_compare(seed):
     emask = rng.random(ep) < 0.9
     args = tuple(jnp.asarray(x) for x in (nbr, ea, eb_, emask))
     assert int(intersect_local_pallas(*args)) == int(
+        tri_ops.intersect_local(*args))
+
+
+def test_pallas_intersect_multi_slab(monkeypatch):
+    """Edge buckets beyond MAX_TILES*TILE_E are processed in several
+    pallas_calls (the [g] partial vector lives in scarce SMEM, so g is
+    capped per call). Shrinking MAX_TILES exercises the slab loop —
+    slab-boundary slicing, whole-slab padding, cross-slab accumulation
+    — with the same small fixture."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops import pallas_intersect
+
+    monkeypatch.setattr(pallas_intersect, "MAX_TILES", 2)  # 128-edge slabs
+    rng = np.random.default_rng(11)
+    vb, k, ep = 64, 128, 300   # pads to 384 = 3 slabs, ragged last slab
+    fill = rng.integers(0, vb, size=(vb + 1, k)).astype(np.int32)
+    fill.sort(axis=1)
+    dup = np.concatenate(
+        [np.zeros((vb + 1, 1), bool), fill[:, 1:] == fill[:, :-1]], axis=1)
+    nbr = np.where(dup, vb, fill).astype(np.int32)
+    ea = rng.integers(0, vb, ep).astype(np.int32)
+    eb_ = rng.integers(0, vb, ep).astype(np.int32)
+    emask = rng.random(ep) < 0.9
+    args = tuple(jnp.asarray(x) for x in (nbr, ea, eb_, emask))
+    assert int(pallas_intersect.intersect_local_pallas(*args)) == int(
         tri_ops.intersect_local(*args))
 
 

@@ -347,38 +347,139 @@ print(json.dumps(out))
     return json.loads(res.stdout.strip().splitlines()[-1])
 
 
+SECTIONS = {
+    "intersect": section_intersect,
+    "window": section_window,
+    "fused": section_fused,
+    "dense": section_dense,
+    "driver": section_driver,
+}
+
+
+def run_section_child(name: str) -> None:
+    """Child mode: run ONE chip section in-process and print its JSON
+    line. The orchestrator owns the timeout; this process just works."""
+    import jax
+
+    results = {"backend": jax.default_backend(),
+               "device": str(jax.devices()[0])}
+    SECTIONS[name](results)
+    print(json.dumps({name: results[name], "backend": results["backend"],
+                      "device": results["device"]}), flush=True)
+
+
+def run_section_subprocess(name: str, timeout_s: int) -> dict:
+    """Run one chip section in its own process group with a hard
+    timeout. A wedged remote compile (the tunnel's known failure mode:
+    one oversized program stalled it >30 min in round 2) then costs ONE
+    section, not the whole profile."""
+    from bench import run_with_hard_timeout
+
+    rc, stdout, stderr = run_with_hard_timeout(
+        [sys.executable, os.path.abspath(__file__), "--section", name],
+        timeout_s)
+    if rc is None:
+        return {"error": "timeout after %ds (wedged compile?)" % timeout_s}
+    if rc != 0:
+        return {"error": "rc=%d: %s" % (rc, stderr.strip()[-500:])}
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except (ValueError, json.JSONDecodeError):
+            continue
+    return {"error": "no JSON line in section output"}
+
+
 def main():
-    want = set(sys.argv[1:]) or {"intersect", "window", "fused", "dense",
-                                 "driver", "sharded"}
+    if len(sys.argv) >= 3 and sys.argv[1] == "--section":
+        run_section_child(sys.argv[2])
+        return
+
+    args = sys.argv[1:]
+    unknown = [a for a in args if a not in SECTIONS and a != "sharded"]
+    if unknown:
+        sys.exit("unknown section(s) %s; valid: %s"
+                 % (unknown, list(SECTIONS) + ["sharded"]))
+    want = [s for s in list(SECTIONS) + ["sharded"]
+            if not args or s in args]
+    timeout_s = int(os.environ.get("GS_PROFILE_SECTION_TIMEOUT", "2400"))
+    perf_path = os.path.join(REPO, "PERF.json")
     results = {}
+    ok_sections = []
+    wrote = [None]
 
-    if want - {"sharded"}:
-        import jax
+    try:
+        with open(perf_path) as f:
+            prior = json.load(f)
+    except (OSError, ValueError):
+        prior = None
 
-        results["backend"] = jax.default_backend()
-        results["device"] = str(jax.devices()[0])
-    if "intersect" in want:
-        section_intersect(results)
-        print(json.dumps({"intersect": results["intersect"]}), flush=True)
-    if "window" in want:
-        section_window(results)
-        print(json.dumps({"window": results["window"]}), flush=True)
-    if "fused" in want:
-        section_fused(results)
-        print(json.dumps({"fused": results["fused"]}), flush=True)
-    if "dense" in want:
-        section_dense(results)
-        print(json.dumps({"dense": results["dense"]}), flush=True)
-    if "driver" in want:
-        section_driver(results)
-        print(json.dumps({"driver": results["driver"]}), flush=True)
+    def flush():
+        # PERF.json drives the library's kernel auto-selection
+        # (ops/triangles._load_tpu_perf), so a profiling RUN must never
+        # degrade it:
+        #  - no successful section yet -> write PERF.json.partial only;
+        #  - same backend as the existing file -> merge this run's
+        #    successful sections over it (a subset or interrupted run
+        #    keeps the other sections' committed measurements);
+        #  - different backend -> replace only when THIS run is the
+        #    chip ('tpu'); a CPU-fallback run never overwrites a
+        #    TPU-labeled file (it would silently deselect the measured
+        #    kernels).
+        backend = results.get("backend")
+        merged = dict(results)
+        if prior is not None and prior.get("backend") == backend:
+            merged = dict(prior)
+            merged.update({k: v for k, v in results.items()
+                           if not (isinstance(v, dict) and "error" in v)})
+        replacing_other_backend = (
+            prior is not None and prior.get("backend") != backend)
+        usable = bool(ok_sections) and not (
+            replacing_other_backend and prior.get("backend") == "tpu"
+            and backend != "tpu")
+        path = perf_path if usable else perf_path + ".partial"
+        with open(path, "w") as f:
+            json.dump(merged, f, indent=2)
+        wrote[0] = path
+
+    chip_sections = [s for s in want if s != "sharded"]
+    if chip_sections:
+        from bench import probe_backend
+
+        platform = probe_backend()
+        results["backend"] = platform or "unavailable"
+        if platform is None:
+            print("no backend; skipping chip sections", file=sys.stderr)
+            chip_sections = []
+        flush()
+    elif prior is not None:
+        # sharded-only run: keep the existing file's chip identity
+        results["backend"] = prior.get("backend")
+        results["device"] = prior.get("device")
+    for name in chip_sections:
+        got = run_section_subprocess(name, timeout_s)
+        # Trust the backend the CHILD measured on, not the pre-run
+        # probe: a tunnel drop between probe and section would
+        # otherwise commit CPU-fallback timings labeled as chip ones.
+        child_backend = got.get("backend")
+        if "error" not in got and child_backend != results["backend"]:
+            got = {"error": "backend mismatch: probed %s, section ran "
+                            "on %s" % (results["backend"], child_backend)}
+        if got.get("device"):
+            results.setdefault("device", got["device"])
+        results[name] = got.get(name, got if "error" in got else
+                                {"error": "missing section key"})
+        if "error" not in results[name]:
+            ok_sections.append(name)
+        print(json.dumps({name: results[name]}), flush=True)
+        flush()
     if "sharded" in want:
         results["sharded"] = section_sharded(REPO)
+        if "error" not in results["sharded"]:
+            ok_sections.append("sharded")
         print(json.dumps({"sharded": results["sharded"]}), flush=True)
-
-    with open(os.path.join(REPO, "PERF.json"), "w") as f:
-        json.dump(results, f, indent=2)
-    print("wrote PERF.json", file=sys.stderr)
+        flush()
+    print("wrote %s" % wrote[0], file=sys.stderr)
 
 
 if __name__ == "__main__":
