@@ -83,6 +83,28 @@ def run_with_hard_timeout(argv, timeout_s: int, env=None):
         return rc, out.read(), err.read()
 
 
+def run_json_child(argv, timeout_s: int, env=None, require_key=None):
+    """run_with_hard_timeout + parse the LAST JSON object line of the
+    child's stdout (optionally requiring a key, to skip progress
+    lines). Returns {'error': ...} on timeout/nonzero-rc/no-JSON — the
+    shared child contract of tools/profile_kernels.py sections and
+    tools/scale_run.py legs."""
+    rc, stdout, stderr = run_with_hard_timeout(argv, timeout_s, env=env)
+    if rc is None:
+        return {"error": "timeout after %ds (wedged compile?)" % timeout_s}
+    if rc != 0:
+        return {"error": "rc=%d: %s" % (rc, stderr.strip()[-800:])}
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            got = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(got, dict) and (require_key is None
+                                      or got.get(require_key)):
+            return got
+    return {"error": "no JSON line in child output"}
+
+
 def probe_backend(attempts: int = None, timeout_s: int = None,
                   backoff_s: int = 20):
     """Check in a SUBPROCESS (with a hard timeout) that jax can bring up

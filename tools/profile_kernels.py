@@ -24,7 +24,6 @@ chip numbers (same contract as bench.py).
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -338,13 +337,15 @@ for name, eng in (
                  "edges_per_s": round(num_w * eb / t)}
 print(json.dumps(out))
 """ % REPO
-    env = dict(os.environ, JAX_PLATFORMS="cpu",
+    # PYTHONPATH is stripped so the baked sitecustomize can't dial the
+    # (possibly wedged) PJRT relay from the CPU child; the code above
+    # sys.path-inserts the repo itself. run_json_child gives the same
+    # killpg-on-timeout contract as the chip sections.
+    from bench import run_json_child
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    res = subprocess.run([sys.executable, "-c", code], env=env,
-                         capture_output=True, text=True, timeout=1800)
-    if res.returncode != 0:
-        return {"error": res.stderr[-500:]}
-    return json.loads(res.stdout.strip().splitlines()[-1])
+    return run_json_child([sys.executable, "-c", code], 1800, env=env)
 
 
 SECTIONS = {
@@ -373,21 +374,11 @@ def run_section_subprocess(name: str, timeout_s: int) -> dict:
     timeout. A wedged remote compile (the tunnel's known failure mode:
     one oversized program stalled it >30 min in round 2) then costs ONE
     section, not the whole profile."""
-    from bench import run_with_hard_timeout
+    from bench import run_json_child
 
-    rc, stdout, stderr = run_with_hard_timeout(
+    return run_json_child(
         [sys.executable, os.path.abspath(__file__), "--section", name],
         timeout_s)
-    if rc is None:
-        return {"error": "timeout after %ds (wedged compile?)" % timeout_s}
-    if rc != 0:
-        return {"error": "rc=%d: %s" % (rc, stderr.strip()[-500:])}
-    for line in reversed(stdout.strip().splitlines()):
-        try:
-            return json.loads(line)
-        except (ValueError, json.JSONDecodeError):
-            continue
-    return {"error": "no JSON line in section output"}
 
 
 def main():
@@ -430,8 +421,14 @@ def main():
         merged = dict(results)
         if prior is not None and prior.get("backend") == backend:
             merged = dict(prior)
-            merged.update({k: v for k, v in results.items()
-                           if not (isinstance(v, dict) and "error" in v)})
+            for k, v in results.items():
+                if isinstance(v, dict) and "error" in v and k in prior:
+                    # keep the prior measurement but make the failed
+                    # refresh visible in the committed file
+                    merged[k + "_refresh_error"] = v
+                else:
+                    merged[k] = v
+                    merged.pop(k + "_refresh_error", None)
         replacing_other_backend = (
             prior is not None and prior.get("backend") != backend)
         usable = bool(ok_sections) and not (
