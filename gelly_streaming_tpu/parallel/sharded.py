@@ -103,15 +103,17 @@ def make_sharded_triangle_fn(mesh):
 
     # NOT resolve_intersect_impl(): pl.pallas_call inside shard_map
     # fails jax 0.9's check_vma at trace time (vma=None on the
-    # out_shape), so sharded bodies pin the XLA compare regardless of
-    # the single-chip measurement-driven choice
+    # out_shape), so sharded bodies use the XLA-only selection
+    # (compare on chip, binary search on CPU meshes)
+    intersect = triangles.resolve_xla_intersect()
+
     @functools.partial(
         shard_map, mesh=mesh,
         in_specs=(P(), P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS)),
         out_specs=P(),
     )
     def step(nbr, ea, eb, emask):
-        local = triangles.intersect_local(nbr, ea, eb, emask)
+        local = intersect(nbr, ea, eb, emask)
         return jax.lax.psum(local, SHARD_AXIS)
 
     return jax.jit(step)
@@ -161,8 +163,13 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
     assert eb % n == 0 and kb % n == 0, (eb, kb, n)
     sent = vb
     kslice = kb // n
-    # XLA compare, NOT the measured single-chip choice: pallas_call in
-    # shard_map trips check_vma (see make_sharded_triangle_fn)
+    # Pinned to the broadcast compare. Not resolve_intersect_impl():
+    # pallas_call in shard_map trips check_vma. Not
+    # resolve_xla_intersect() either: the nbr table below is assembled
+    # as per-shard kslice column runs merged by pmax, so each row is a
+    # CONCATENATION of sorted runs, not globally sorted — the binary
+    # search's searchsorted contract doesn't hold (the equality compare
+    # doesn't care about order).
     intersect = triangles.intersect_local
 
     def step(src, dst, valid):

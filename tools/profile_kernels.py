@@ -76,19 +76,10 @@ def section_intersect(results: dict) -> None:
     emask = np.ones(ep, bool)
     args = tuple(jnp.asarray(x) for x in (nbr, ea, eb_, emask))
 
-    compare = jax.jit(intersect_local)
+    from gelly_streaming_tpu.ops.triangles import intersect_local_bsearch
 
-    @jax.jit
-    def binary_search(nbr, ea, eb, emask):
-        sentinel = nbr.shape[0] - 1
-        rows_a = nbr[ea]                       # [Ep, K]
-        rows_b = nbr[eb]
-        # for each element of rows_a, binary-search rows_b's sorted row
-        pos = jax.vmap(jnp.searchsorted)(rows_b, rows_a)   # [Ep, K]
-        hit = jnp.take_along_axis(
-            rows_b, jnp.clip(pos, 0, nbr.shape[1] - 1), axis=1) == rows_a
-        valid = (rows_a < sentinel) & emask[:, None]
-        return jnp.sum(hit & valid, dtype=jnp.int32)
+    compare = jax.jit(intersect_local)
+    binary_search = jax.jit(intersect_local_bsearch)
 
     from gelly_streaming_tpu.ops import pallas_intersect
 
@@ -418,17 +409,27 @@ def main():
         #    TPU-labeled file (it would silently deselect the measured
         #    kernels).
         backend = results.get("backend")
-        merged = dict(results)
+        # A failed section NEVER lands under its section key (library
+        # consumers iterate section rows and would crash/mislead on an
+        # {"error": ...} stub; _load_tpu_perf also filters these) —
+        # it is recorded under <name>_error instead.
+        merged = {}
+        for k, v in results.items():
+            if isinstance(v, dict) and "error" in v:
+                merged[k + "_error"] = v
+            else:
+                merged[k] = v
         if prior is not None and prior.get("backend") == backend:
-            merged = dict(prior)
+            base = dict(prior)
             for k, v in results.items():
-                if isinstance(v, dict) and "error" in v and k in prior:
-                    # keep the prior measurement but make the failed
+                if isinstance(v, dict) and "error" in v:
+                    # keep any prior measurement; make the failed
                     # refresh visible in the committed file
-                    merged[k + "_refresh_error"] = v
+                    base[k + "_error"] = v
                 else:
-                    merged[k] = v
-                    merged.pop(k + "_refresh_error", None)
+                    base[k] = v
+                    base.pop(k + "_error", None)
+            merged = base
         replacing_other_backend = (
             prior is not None and prior.get("backend") != backend)
         usable = bool(ok_sections) and not (

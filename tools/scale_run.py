@@ -196,7 +196,7 @@ def run_fused(path: str) -> dict:
     }
 
 
-def run_sharded(path: str) -> dict:
+def run_sharded(path: str, timeout_s: int = 3600) -> dict:
     code = r"""
 import json, sys, time
 import numpy as np
@@ -237,7 +237,7 @@ print(json.dumps({
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
                XLA_FLAGS="--xla_force_host_platform_device_count=8")
-    got = run_json_child([sys.executable, "-c", code], 3600, env=env)
+    got = run_json_child([sys.executable, "-c", code], timeout_s, env=env)
     if "error" in got:
         got["leg"] = "sharded-fused-scan"
     return got
@@ -255,7 +255,7 @@ def run_leg_subprocess(leg: str, fixture: str, timeout_s: int,
     from bench import run_json_child
 
     if leg == "sharded":
-        return run_sharded(fixture)
+        return run_sharded(fixture, timeout_s)
     got = run_json_child(
         [sys.executable, os.path.abspath(__file__), "--leg", leg,
          "--out", fixture], timeout_s, env=env, require_key="leg")
@@ -304,17 +304,24 @@ def main():
         #  - prior at a LARGER scale -> this (dev/test) run stays in
         #    .partial; legs from different NUM_EDGES are not
         #    comparable under one meta block;
-        #  - prior at the SAME scale -> merge per-leg, where a cpu-
-        #    fallback leg never replaces a chip-measured one and a
-        #    failed leg keeps the prior file's version;
-        #  - prior at a smaller scale (or absent) -> fresh replace,
-        #    usable once any leg succeeded.
+        #  - prior with IDENTICAL meta (every generator parameter, not
+        #    just num_edges — they are all env-overridable) -> merge
+        #    per-leg, where a cpu-fallback leg never replaces a chip-
+        #    measured one and a failed leg keeps the prior version;
+        #  - otherwise (smaller/absent/incomparable-meta prior) ->
+        #    fresh whole-file replace once any leg succeeded, unless
+        #    that would swap chip evidence for a cpu fallback.
+        meta_keys = ("num_edges", "edges_per_window", "v_start",
+                     "v_end", "seed")
         new_ok = [leg for leg in results["legs"] if "error" not in leg]
         merged = dict(results)
-        usable = bool(new_ok)
+        usable = bool(new_ok) and not (
+            prior is not None and _chip(prior.get("legs", []))
+            and not _chip(new_ok))
         if prior is not None and prior.get("num_edges", 0) > NUM_EDGES:
             usable = False
-        elif prior is not None and prior.get("num_edges") == NUM_EDGES:
+        elif prior is not None and all(
+                prior.get(k) == results[k] for k in meta_keys):
             by_name = {leg.get("leg"): leg
                        for leg in prior.get("legs", [])}
             replaced = 0
