@@ -176,10 +176,12 @@ def _load_tpu_perf():
 
 def resolve_intersect_impl():
     """The intersection kernel actually built into the window-counter
-    programs: the XLA chunked broadcast compare by default, the Pallas
-    fused-tile variant (ops/pallas_intersect.py) only when committed
-    TPU measurements (PERF.json `intersect` section) show it at parity
-    and ≥5% faster — same selection policy as the dense path."""
+    programs: the backend's measured XLA winner by default (chunked
+    broadcast compare on chip, binary search on CPU —
+    resolve_xla_intersect), upgraded to the Pallas fused-tile variant
+    (ops/pallas_intersect.py) only when committed TPU measurements
+    (PERF.json `intersect` section) show it at parity and ≥5% faster —
+    same selection policy as the dense path."""
     global _INTERSECT_CHOICE
     if _INTERSECT_CHOICE is not None:
         return _INTERSECT_CHOICE

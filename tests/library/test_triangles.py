@@ -91,6 +91,41 @@ def test_kernels_vs_brute_force(seed, kernel):
     assert fn(src, dst, n) == expected
 
 
+def test_cpu_backend_selects_binary_search_intersect():
+    """On CPU backends the measured winner is the binary search (~5x,
+    PERF.md `intersect`); the resolvers must pick it — and it must
+    agree with the broadcast compare on the sorted-row contract the
+    single-chip builder guarantees (build_window_counter sorts via
+    dedupe_pairs + CSR positions)."""
+    import jax
+    import jax.numpy as jnp
+
+    assert jax.default_backend() == "cpu"  # conftest pins the backend
+    tri_ops._INTERSECT_CHOICE = None       # force re-resolution
+    try:
+        assert (tri_ops.resolve_intersect_impl()
+                is tri_ops.intersect_local_bsearch)
+        assert (tri_ops.resolve_xla_intersect()
+                is tri_ops.intersect_local_bsearch)
+    finally:
+        tri_ops._INTERSECT_CHOICE = None
+    rng = np.random.default_rng(5)
+    vb, k, ep = 128, 64, 512
+    # rows exactly as the builder lays them out: unique ascending
+    # neighbors packed at the FRONT, sentinel suffix (mid-row sentinels
+    # would break the searchsorted contract — and never occur)
+    nbr = np.full((vb + 1, k), vb, np.int32)
+    for v in range(vb):
+        row = np.unique(rng.integers(0, vb, size=k // 2))
+        nbr[v, :len(row)] = row.astype(np.int32)
+    ea = rng.integers(0, vb, ep).astype(np.int32)
+    eb_ = rng.integers(0, vb, ep).astype(np.int32)
+    emask = rng.random(ep) < 0.9
+    args = tuple(jnp.asarray(x) for x in (nbr, ea, eb_, emask))
+    assert int(tri_ops.intersect_local_bsearch(*args)) == int(
+        tri_ops.intersect_local(*args))
+
+
 @pytest.mark.parametrize("seed", range(3))
 def test_pallas_intersect_matches_xla_compare(seed):
     """The Pallas rows-intersect prototype (ops/pallas_intersect.py)

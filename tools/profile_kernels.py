@@ -412,24 +412,18 @@ def main():
         # A failed section NEVER lands under its section key (library
         # consumers iterate section rows and would crash/mislead on an
         # {"error": ...} stub; _load_tpu_perf also filters these) —
-        # it is recorded under <name>_error instead.
-        merged = {}
+        # it is recorded under <name>_error, keeping any prior
+        # measurement. A same-backend prior file seeds the merge; any
+        # other prior is ignored here (the usable check below decides
+        # whether this run may replace it at all).
+        merged = (dict(prior) if prior is not None
+                  and prior.get("backend") == backend else {})
         for k, v in results.items():
             if isinstance(v, dict) and "error" in v:
                 merged[k + "_error"] = v
             else:
                 merged[k] = v
-        if prior is not None and prior.get("backend") == backend:
-            base = dict(prior)
-            for k, v in results.items():
-                if isinstance(v, dict) and "error" in v:
-                    # keep any prior measurement; make the failed
-                    # refresh visible in the committed file
-                    base[k + "_error"] = v
-                else:
-                    base[k] = v
-                    base.pop(k + "_error", None)
-            merged = base
+                merged.pop(k + "_error", None)
         replacing_other_backend = (
             prior is not None and prior.get("backend") != backend)
         usable = bool(ok_sections) and not (
