@@ -78,8 +78,9 @@ class DataStream:
         spec = KeySpec(selector=selector) if selector else KeySpec(fields=fields)
         return KeyedStream(self.env, self.node, spec)
 
-    def time_window_all(self, size: Time) -> "AllWindowedStream":
-        return AllWindowedStream(self.env, self.node, size)
+    def time_window_all(self, size: Time,
+                        slide: Optional[Time] = None) -> "AllWindowedStream":
+        return AllWindowedStream(self.env, self.node, size, slide)
 
     # ------------------------------------------------------------------
     # iteration (reference: IterativeConnectedComponents.java:56-58)
@@ -128,8 +129,14 @@ class KeyedStream:
         self.key_spec = key_spec
         self.node = OpNode("key_by", [parent], key_spec=key_spec)
 
-    def time_window(self, size: Time) -> "WindowedStream":
-        return WindowedStream(self.env, self.node, self.key_spec, size)
+    def time_window(self, size: Time,
+                    slide: Optional[Time] = None) -> "WindowedStream":
+        """Tumbling windows by default; pass `slide` for SLIDING windows
+        (Flink's KeyedStream.timeWindow(size, slide) — part of the
+        substrate surface, though the reference's examples only ever
+        use the tumbling form, SimpleEdgeStream.java:159-167)."""
+        return WindowedStream(self.env, self.node, self.key_spec, size,
+                              slide)
 
     def map(self, fn) -> DataStream:
         """Keyed stateful map: fn(value) -> value; fn may be a callable object
@@ -147,28 +154,35 @@ class KeyedStream:
 
 
 class WindowedStream:
-    """Tumbling time windows over a keyed stream
+    """Tumbling (slide=None) or sliding time windows over a keyed stream
     (reference: KeyedStream.timeWindow → WindowedStream)."""
 
-    def __init__(self, env, parent: OpNode, key_spec: KeySpec, size: Time):
+    def __init__(self, env, parent: OpNode, key_spec: KeySpec, size: Time,
+                 slide: Optional[Time] = None):
         self.env = env
         self.parent = parent
         self.key_spec = key_spec
         self.size = size
+        self.slide = slide
+
+    def _slide_ms(self) -> Optional[int]:
+        return self.slide.milliseconds if self.slide is not None else None
 
     def fold(self, initial: Any, fn: Callable[[Any, Any], Any]) -> DataStream:
         """Incremental per-(key,window) fold, arrival order
         (reference: GraphWindowStream.java:63, WindowGraphAggregation.java:58)."""
         node = OpNode(
             "window", [self.parent], key_spec=self.key_spec,
-            size_ms=self.size.milliseconds, op="fold", initial=initial, fn=fn,
+            size_ms=self.size.milliseconds, slide_ms=self._slide_ms(),
+            op="fold", initial=initial, fn=fn,
         )
         return DataStream(self.env, node)
 
     def reduce(self, fn: Callable[[Any, Any], Any]) -> DataStream:
         node = OpNode(
             "window", [self.parent], key_spec=self.key_spec,
-            size_ms=self.size.milliseconds, op="reduce", fn=fn,
+            size_ms=self.size.milliseconds, slide_ms=self._slide_ms(),
+            op="reduce", fn=fn,
         )
         return DataStream(self.env, node)
 
@@ -177,37 +191,45 @@ class WindowedStream:
         (reference: WindowedStream.apply, GraphWindowStream.java:131)."""
         node = OpNode(
             "window", [self.parent], key_spec=self.key_spec,
-            size_ms=self.size.milliseconds, op="apply", fn=fn,
+            size_ms=self.size.milliseconds, slide_ms=self._slide_ms(),
+            op="apply", fn=fn,
         )
         return DataStream(self.env, node)
 
     def sum(self, field: int) -> DataStream:
         node = OpNode(
             "window", [self.parent], key_spec=self.key_spec,
-            size_ms=self.size.milliseconds, op="sum", field=field,
+            size_ms=self.size.milliseconds, slide_ms=self._slide_ms(),
+            op="sum", field=field,
         )
         return DataStream(self.env, node)
 
 
 class AllWindowedStream:
-    """Non-keyed tumbling windows (reference: WindowTriangles.java:66)."""
+    """Non-keyed tumbling/sliding windows
+    (reference: WindowTriangles.java:66)."""
 
-    def __init__(self, env, parent: OpNode, size: Time):
+    def __init__(self, env, parent: OpNode, size: Time,
+                 slide: Optional[Time] = None):
         self.env = env
         self.parent = parent
         self.size = size
+        self.slide = slide
+
+    def _slide_ms(self) -> Optional[int]:
+        return self.slide.milliseconds if self.slide is not None else None
 
     def sum(self, field: int) -> DataStream:
         node = OpNode(
             "window_all", [self.parent], size_ms=self.size.milliseconds,
-            op="sum", field=field,
+            slide_ms=self._slide_ms(), op="sum", field=field,
         )
         return DataStream(self.env, node)
 
     def apply(self, fn) -> DataStream:
         node = OpNode(
             "window_all", [self.parent], size_ms=self.size.milliseconds,
-            op="apply", fn=fn,
+            slide_ms=self._slide_ms(), op="apply", fn=fn,
         )
         return DataStream(self.env, node)
 

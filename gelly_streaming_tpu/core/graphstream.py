@@ -257,12 +257,18 @@ class SimpleEdgeStream(GraphStream):
                 .key_by(0).map(vertex_mapper))
 
     def slice(self, size: Time,
-              direction: EdgeDirection = EdgeDirection.OUT) -> "GraphWindowStream":
+              direction: EdgeDirection = EdgeDirection.OUT,
+              slide: "Time" = None) -> "GraphWindowStream":
         """Discretize into tumbling windows keyed so a vertex's whole
         neighborhood lands in one partition
         (reference: SimpleEdgeStream.java:139-171: IN → reverse() then key
         by source; OUT → key by source; ALL → undirected() doubling then
-        key by source)."""
+        key by source).
+
+        Pass `slide` for SLIDING windows — each edge then contributes to
+        every window whose [start, start+size) span covers its
+        timestamp. The substrate (Flink timeWindow(size, slide))
+        supports this; the reference's examples only use tumbling."""
         if direction == EdgeDirection.IN:
             stream = self.reverse()
         elif direction == EdgeDirection.OUT:
@@ -271,7 +277,7 @@ class SimpleEdgeStream(GraphStream):
             stream = self.undirected()
         else:
             raise ValueError("Illegal edge direction")
-        return GraphWindowStream(self.env, stream.get_edges(), size)
+        return GraphWindowStream(self.env, stream.get_edges(), size, slide)
 
 
 class GraphWindowStream:
@@ -283,14 +289,19 @@ class GraphWindowStream:
     apply materializes padded neighborhoods (SURVEY.md §3.2).
     """
 
-    def __init__(self, env, keyed_edges: DataStream, size: Time):
+    def __init__(self, env, keyed_edges: DataStream, size: Time,
+                 slide: Time = None):
         self.env = env
         self.edges = keyed_edges
         self.size = size
+        self.slide = slide
 
     def _window_node(self, kernel) -> DataStream:
         node = OpNode("window_batch", [self.edges.node],
-                      size_ms=self.size.milliseconds, kernel=kernel)
+                      size_ms=self.size.milliseconds,
+                      slide_ms=(self.slide.milliseconds
+                                if self.slide is not None else None),
+                      kernel=kernel)
         return DataStream(self.env, node)
 
     def fold_neighbors(self, initial_or_fold, fold_udf=None) -> DataStream:

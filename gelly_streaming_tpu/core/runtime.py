@@ -254,16 +254,34 @@ class Executor:
         return [(item, clock.now_ms()) for item in items]
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _window_starts(ts: int, size: int, slide) -> List[int]:
+        """Starts of every window containing ts. Tumbling (slide=None):
+        the single aligned window. Sliding: all starts in (ts-size, ts]
+        aligned to the slide (Flink's SlidingEventTimeWindows
+        assignment), ascending."""
+        if slide is None or slide == size:
+            return [ts - ts % size]
+        starts = []
+        s = ts - ts % slide
+        while s > ts - size:
+            starts.append(s)
+            s -= slide
+        starts.reverse()
+        return starts
+
     def _eval_window(self, node: OpNode, records: List[Record]) -> List[Record]:
         key_spec = node.params["key_spec"]
         size = node.params["size_ms"]
+        slide = node.params.get("slide_ms")
         groups: Dict[Tuple[Any, int], List[Record]] = defaultdict(list)
         order: List[Tuple[Any, int]] = []
         for v, ts in records:
-            k = (key_spec.key_of(v), ts - ts % size)
-            if k not in groups:
-                order.append(k)
-            groups[k].append((v, ts))
+            for wstart in self._window_starts(ts, size, slide):
+                k = (key_spec.key_of(v), wstart)
+                if k not in groups:
+                    order.append(k)
+                groups[k].append((v, ts))
         # fire in ascending window end; ties by first arrival
         order.sort(key=lambda kw: kw[1])
         out: List[Record] = []
@@ -277,9 +295,11 @@ class Executor:
 
     def _eval_window_all(self, node: OpNode, records: List[Record]) -> List[Record]:
         size = node.params["size_ms"]
+        slide = node.params.get("slide_ms")
         groups: Dict[int, List[Any]] = defaultdict(list)
         for v, ts in records:
-            groups[ts - ts % size].append(v)
+            for wstart in self._window_starts(ts, size, slide):
+                groups[wstart].append(v)
         out: List[Record] = []
         for wstart in sorted(groups):
             wmax = wstart + size - 1
@@ -319,14 +339,31 @@ class Executor:
 
     # ------------------------------------------------------------------
     def _eval_window_batch(self, node: OpNode, records: List[Record]) -> List[Record]:
-        """Device hot path: group records into tumbling windows and hand each
+        """Device hot path: group records into windows and hand each
         window to a columnar kernel: kernel(values, window_max_ts) -> [(v, ts)].
+
+        Sliding windows (slide_ms set): when the kernel advertises a
+        pane path (`kernel.pane_kernel`) and the slide divides the
+        size, records are grouped ONCE by slide-sized pane and all
+        windows are computed in one batched device dispatch from
+        per-pane partials — no edge duplication. Otherwise each record
+        is assigned to every covering window (factor size/slide
+        duplication, always correct).
         """
         size = node.params["size_ms"]
+        slide = node.params.get("slide_ms")
         kernel = node.params["kernel"]
+        pane_kernel = getattr(kernel, "pane_kernel", None)
+        if (slide is not None and slide != size and pane_kernel is not None
+                and size % slide == 0):
+            panes: Dict[int, List[Any]] = defaultdict(list)
+            for v, ts in records:
+                panes[ts - ts % slide].append(v)
+            return pane_kernel(panes, size, slide)
         groups: Dict[int, List[Any]] = defaultdict(list)
         for v, ts in records:
-            groups[ts - ts % size].append(v)
+            for wstart in self._window_starts(ts, size, slide):
+                groups[wstart].append(v)
         out: List[Record] = []
         for wstart in sorted(groups):
             out.extend(kernel(groups[wstart], wstart + size - 1))
