@@ -83,6 +83,17 @@ def run_with_hard_timeout(argv, timeout_s: int, env=None):
         return rc, out.read(), err.read()
 
 
+def clean_cpu_env(**extra):
+    """Env for a hermetic CPU child: JAX pinned to cpu AND the baked
+    sitecustomize's PJRT plugin registration stripped (PYTHONPATH="") —
+    with a wedged tunnel the plugin otherwise hangs every process at
+    backend init, even under JAX_PLATFORMS=cpu. Shared by the bench
+    fallback and both evidence tools."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="")
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
 def run_json_child(argv, timeout_s: int, env=None, require_key=None):
     """run_with_hard_timeout + parse the LAST JSON object line of the
     child's stdout (optionally requiring a key, to skip progress
@@ -313,8 +324,7 @@ def main():
             # (hanging) TPU plugin; JAX_PLATFORMS=cpu pins the backend.
             print("backend unavailable -> re-exec with hermetic CPU "
                   "backend", file=sys.stderr)
-            env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
-                       GS_BENCH_CPU_FALLBACK="1")
+            env = clean_cpu_env(GS_BENCH_CPU_FALLBACK="1")
             os.execve(sys.executable, [sys.executable] + sys.argv, env)
         elif platform == "cpu":
             # a healthy probe of a CPU-only jax is NOT a chip result

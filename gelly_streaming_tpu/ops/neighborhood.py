@@ -279,13 +279,15 @@ def _make_pane_reduce(name: str, per_window_kernel):
             accc = accc + pc[k:k + n_w]
         accv, accc = np.asarray(accv), np.asarray(accc)
 
-        out = []
-        for w in range(n_panes + wp - 1):
-            wmax = p0 + (w - (wp - 1)) * slide + size - 1
-            for i in range(n_seg):
-                if accc[w, i]:
-                    out.append(((_py(uniq[i]), _py(accv[w, i])), wmax))
-        return out
+        # emit only occupied (window, vertex) cells, vectorized — a
+        # dense Python scan of the (windows x vertex-bucket) grid would
+        # cost more host time than the device dispatch saved
+        ws, vs = np.nonzero(accc[:n_panes + wp - 1, :n_seg])
+        return [
+            ((_py(uniq[v]), _py(accv[w, v])),
+             p0 + (w - (wp - 1)) * slide + size - 1)
+            for w, v in zip(ws.tolist(), vs.tolist())
+        ]
 
     return pane_kernel
 

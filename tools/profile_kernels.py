@@ -334,8 +334,10 @@ print(json.dumps(out))
     # killpg-on-timeout contract as the chip sections.
     from bench import run_json_child
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    from bench import clean_cpu_env
+
+    env = clean_cpu_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8")
     return run_json_child([sys.executable, "-c", code], 1800, env=env)
 
 
@@ -360,7 +362,7 @@ def run_section_child(name: str) -> None:
                       "device": results["device"]}), flush=True)
 
 
-def run_section_subprocess(name: str, timeout_s: int) -> dict:
+def run_section_subprocess(name: str, timeout_s: int, env=None) -> dict:
     """Run one chip section in its own process group with a hard
     timeout. A wedged remote compile (the tunnel's known failure mode:
     one oversized program stalled it >30 min in round 2) then costs ONE
@@ -369,7 +371,7 @@ def run_section_subprocess(name: str, timeout_s: int) -> dict:
 
     return run_json_child(
         [sys.executable, os.path.abspath(__file__), "--section", name],
-        timeout_s)
+        timeout_s, env=env)
 
 
 def main():
@@ -435,21 +437,31 @@ def main():
         wrote[0] = path
 
     chip_sections = [s for s in want if s != "sharded"]
+    child_env = None
     if chip_sections:
         from bench import probe_backend
 
         platform = probe_backend()
-        results["backend"] = platform or "unavailable"
         if platform is None:
-            print("no backend; skipping chip sections", file=sys.stderr)
-            chip_sections = []
+            # Same CPU fallback as tools/scale_run.py: sections still
+            # run (honestly labeled cpu), in a clean env with the
+            # wedged PJRT plugin's registration stripped. The
+            # kernel-inversion measurements (intersect/dense choices)
+            # are exactly the kind of data a labeled CPU run records.
+            print("no chip backend; sections fall back to clean-CPU env",
+                  file=sys.stderr)
+            from bench import clean_cpu_env
+
+            child_env = clean_cpu_env()
+            platform = "cpu"
+        results["backend"] = platform
         flush()
     elif prior is not None:
         # sharded-only run: keep the existing file's chip identity
         results["backend"] = prior.get("backend")
         results["device"] = prior.get("device")
     for name in chip_sections:
-        got = run_section_subprocess(name, timeout_s)
+        got = run_section_subprocess(name, timeout_s, env=child_env)
         # Trust the backend the CHILD measured on, not the pre-run
         # probe: a tunnel drop between probe and section would
         # otherwise commit CPU-fallback timings labeled as chip ones.

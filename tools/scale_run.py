@@ -235,8 +235,10 @@ print(json.dumps({
     # on timeout so a hung child costs one leg, not the run.
     from bench import run_json_child
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH="",
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    from bench import clean_cpu_env
+
+    env = clean_cpu_env(
+        XLA_FLAGS="--xla_force_host_platform_device_count=8")
     got = run_json_child([sys.executable, "-c", code], timeout_s, env=env)
     if "error" in got:
         got["leg"] = "sharded-fused-scan"
@@ -354,8 +356,9 @@ def main():
         if probe_backend() is None:
             print("no chip backend; legs fall back to clean-CPU env",
                   file=sys.stderr)
-            child_env = dict(os.environ, JAX_PLATFORMS="cpu",
-                             PYTHONPATH="")
+            from bench import clean_cpu_env
+
+            child_env = clean_cpu_env()
     for leg in args.legs:
         r = run_leg_subprocess(leg, args.out, timeout_s, env=child_env)
         results["legs"].append(r)
