@@ -150,20 +150,23 @@ _INTERSECT_CHOICE = None   # resolved once per process
 _INTERSECT_JIT = None      # jitted form of the choice, built once
 
 
-def _load_tpu_perf():
-    """Parsed PERF.json iff this process runs a TPU backend AND the
-    committed measurements were recorded on one; None otherwise.
-    Shared scaffolding of the measurement-driven kernel selections."""
+def _load_matching_perf(required_backend: str = None):
+    """Parsed PERF.json iff its measurements were recorded on THIS
+    process's backend (pass required_backend to further restrict, e.g.
+    'tpu' for chip-only selections); None otherwise. Shared scaffolding
+    of the measurement-driven kernel selections — a selection must
+    never be driven by another backend's numbers."""
     import json
 
     try:
         import jax as _jax
 
-        if _jax.default_backend() != "tpu":
+        backend = _jax.default_backend()
+        if required_backend is not None and backend != required_backend:
             return None
         with open(_PERF_PATH) as f:
             perf = json.load(f)
-        if perf.get("backend") != "tpu":
+        if perf.get("backend") != backend:
             return None
         # drop failed-section stubs ({"error": ...}) and *_error
         # markers the profiler may record: consumers see only real
@@ -172,6 +175,13 @@ def _load_tpu_perf():
                 if not (isinstance(v, dict) and "error" in v)}
     except Exception:
         return None
+
+
+def _load_tpu_perf():
+    """Chip-only view: PERF.json iff both this process and the file are
+    'tpu' (drives the Pallas/dense selections, which only exist on
+    chip)."""
+    return _load_matching_perf("tpu")
 
 
 def resolve_intersect_impl():
@@ -368,7 +378,12 @@ def _tuned_kb(eb: int) -> int:
     if eb in _TUNED_KB:
         return _TUNED_KB[eb]
     kb = min(128, 2 * int(np.sqrt(eb)))
-    perf = _load_tpu_perf()
+    # K tuning applies per BACKEND: the committed k-sweep for whatever
+    # backend this process runs. The CPU sweep picks K=32 at eb=8192
+    # (~4x over the analytic 128) and K=64 at 32768/65536 (K=32
+    # overflows there and is excluded); the escalation ladder keeps
+    # exactness either way.
+    perf = _load_matching_perf()
     if perf is not None:
         for row in perf.get("window", []):
             if row.get("edge_bucket") != eb:

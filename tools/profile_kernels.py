@@ -121,8 +121,14 @@ def section_window(results: dict) -> None:
     # 8K/32K compile in seconds on the tunnel; the 131072-edge-window
     # program stalled its remote compiler >30 min and wedged it for
     # hours (see bench.py's window cap). Extend via GS_PROFILE_BIG=1
-    # only when babysitting the run.
+    # only when babysitting the run. CPU backends have no such hazard
+    # and the 10M-scale legs use 65536-edge windows, so sweep that size
+    # too off-chip (its tuned K feeds the scale run's kernels).
+    import jax
+
     sizes = (8_192, 32_768)
+    if jax.default_backend() == "cpu":
+        sizes = sizes + (65_536,)
     if os.environ.get("GS_PROFILE_BIG") == "1":
         sizes = sizes + (131_072,)
     out = []
