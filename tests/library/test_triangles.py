@@ -339,10 +339,44 @@ def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
         assert tri_ops._tuned_kb(4096) == 32
         assert tri_ops._tuned_kb(8192) == min(
             128, 2 * int(np.sqrt(8192)))  # unmeasured bucket: heuristic
+
+        # K tuning is backend-MATCHED: a cpu-labeled sweep never tunes
+        # a (fake-)tpu process
+        with open(perf_path, "w") as f:
+            json.dump({"backend": "cpu", "window": [
+                {"edge_bucket": 4096, "k_sweep": [
+                    {"k_bucket": 16, "per_window_ms": 1.0,
+                     "overflow_recounts_per_run": 0}]}]}, f)
+        tri_ops._TUNED_KB.clear()
+        assert tri_ops._tuned_kb(4096) == min(
+            128, 2 * int(np.sqrt(4096)))
     finally:
         tri_ops._DENSE_CHOICE = None
         tri_ops._INTERSECT_CHOICE = None
         tri_ops._INTERSECT_JIT = None
+        tri_ops._TUNED_KB.clear()
+
+
+def test_tuned_kb_uses_cpu_sweep_on_cpu_backend(tmp_path, monkeypatch):
+    """The real backend here IS cpu: a cpu-labeled committed sweep
+    drives K selection (the CPU-fallback speedup path)."""
+    import json
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        pytest.skip("needs a real cpu backend (conftest pins one)")
+    perf_path = str(tmp_path / "PERF.json")
+    monkeypatch.setattr(tri_ops, "_PERF_PATH", perf_path)
+    with open(perf_path, "w") as f:
+        json.dump({"backend": "cpu", "window": [
+            {"edge_bucket": 4096, "k_sweep": [
+                {"k_bucket": 16, "per_window_ms": 1.0,
+                 "overflow_recounts_per_run": 0}]}]}, f)
+    tri_ops._TUNED_KB.clear()
+    try:
+        assert tri_ops._tuned_kb(4096) == 16
+    finally:
         tri_ops._TUNED_KB.clear()
 
 
