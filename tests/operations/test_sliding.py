@@ -161,6 +161,51 @@ def test_sliding_sparse_huge_span_fallback(env):
     assert len(want) > 0
 
 
+def test_sliding_fold_and_apply_host_device_parity(env):
+    """fold (arrival-order, no pane shortcut) and apply (whole
+    neighborhoods) run sliding via per-window assignment on both
+    paths; host and device forms must agree."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu import (EdgesApply, EdgesFold, JaxEdgesApply,
+                                     JaxEdgesFold)
+
+    size, slide = Time.milliseconds_of(200), Time.milliseconds_of(100)
+
+    host_fold = _graph(env).slice(size, EdgeDirection.OUT, slide=slide) \
+        .fold_neighbors((0, 0),
+                        EdgesFold(lambda acc, vid, nid, val:
+                                  (vid, acc[1] + val)))
+    want = run_and_sort(env, host_fold)
+    assert want == SLIDING_SUM
+
+    env2 = type(env)(clock=env.clock)
+    dev_fold = _graph(env2).slice(size, EdgeDirection.OUT, slide=slide) \
+        .fold_neighbors(JaxEdgesFold(
+            init=(jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32)),
+            fn=lambda acc, vid, nid, val: (vid, acc[1] + val)))
+    assert run_and_sort(env2, dev_fold) == want
+
+    def big_small(vid, nbrs, collect):
+        total = sum(v for _n, v in nbrs)
+        collect((vid, "big" if total > 300 else "small"))
+
+    env3 = type(env)(clock=env.clock)
+    host_apply = _graph(env3).slice(size, EdgeDirection.OUT, slide=slide) \
+        .apply_on_neighbors(EdgesApply(big_small))
+    want_a = run_and_sort(env3, host_apply)
+    assert len(want_a) == len(SLIDING_SUM)
+
+    env4 = type(env)(clock=env.clock)
+    dev_apply = _graph(env4).slice(size, EdgeDirection.OUT, slide=slide) \
+        .apply_on_neighbors(JaxEdgesApply(
+            fn=lambda vid, nbrs, vals, mask: jnp.sum(
+                jnp.where(mask, vals, 0)),
+            emit=lambda vid, row: (vid,
+                                   "big" if row[0] > 300 else "small")))
+    assert run_and_sort(env4, dev_apply) == want_a
+
+
 def test_sliding_keyed_window_fold(env):
     """Keyed DataStream.time_window(size, slide) — the generic keyed
     sliding fold (reference substrate: KeyedStream.timeWindow)."""
