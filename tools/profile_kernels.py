@@ -169,6 +169,28 @@ def section_window(results: dict) -> None:
                 "edges_per_s": round(num_w * eb / t),
                 "overflow_recounts_per_run": overflows[0],
             })
+        # chunk sweep (windows per dispatch) at the fastest measured K:
+        # on the tunneled chip each dispatch costs ~0.2s, so chunk size
+        # trades h2d size against dispatch amortization; on CPU it
+        # should be flat (dispatch ~free) — both facts worth pinning
+        clean = [s for s in row["k_sweep"]
+                 if s["overflow_recounts_per_run"] == 0]
+        best_kb = min(clean or row["k_sweep"],
+                      key=lambda s: s["per_window_ms"])["k_bucket"]
+        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                    k_bucket=best_kb)
+        row["chunk_sweep"] = []
+        for cs in (32, 64, 128):
+            kern.MAX_STREAM_WINDOWS = cs
+            kern.count_stream(src, dst)   # warm this chunk shape
+            t = _timeit(lambda: kern.count_stream(src, dst),
+                        reps=3, warmup=0)
+            row["chunk_sweep"].append({
+                "windows_per_dispatch": cs,
+                "default": cs == TriangleWindowKernel.MAX_STREAM_WINDOWS,
+                "per_window_ms": round(t / num_w * 1e3, 3),
+                "edges_per_s": round(num_w * eb / t),
+            })
         out.append(row)
     results["window"] = out
 

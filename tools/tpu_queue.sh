@@ -21,15 +21,24 @@ while true; do
   sleep "$POLL_S"
 done
 
-echo "=== stage 1: bench.py ==="
+echo "=== stage 1: bench.py (first number in hand, untuned K) ==="
 timeout 5400 python bench.py >/tmp/tpuq_bench.log 2>/tmp/tpuq_bench.err
 echo "bench rc=$? ; $(tail -1 /tmp/tpuq_bench.log 2>/dev/null)"
 
-echo "=== stage 2: profile_kernels ==="
+echo "=== stage 2: profile_kernels (writes the chip k-sweep) ==="
 timeout 5400 python tools/profile_kernels.py >/tmp/tpuq_prof.log 2>/tmp/tpuq_prof.err
-echo "profile rc=$?"
+prof_rc=$?
+echo "profile rc=$prof_rc"
 
-echo "=== stage 3: scale_run (driver+fused on chip, sharded on cpu mesh) ==="
+if [ "$prof_rc" -eq 0 ]; then
+  echo "=== stage 3: bench.py again (now reads the chip-tuned K from PERF.json) ==="
+  timeout 5400 python bench.py >/tmp/tpuq_bench2.log 2>/tmp/tpuq_bench2.err
+  echo "bench2 rc=$? ; $(tail -1 /tmp/tpuq_bench2.log 2>/dev/null)"
+else
+  echo "stage 3 skipped: no fresh k-sweep to consume (profile rc=$prof_rc)"
+fi
+
+echo "=== stage 4: scale_run (driver+fused on chip, sharded on cpu mesh) ==="
 timeout 7200 python tools/scale_run.py >/tmp/tpuq_scale.log 2>/tmp/tpuq_scale.err
 echo "scale rc=$?"
 echo "queue done"
