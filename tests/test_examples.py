@@ -55,6 +55,16 @@ def test_bipartiteness_cli(edge_file, tmp_path):
     assert "false" in text.lower()
 
 
+def test_sliding_degree_sums_cli(edge_file, tmp_path):
+    out = str(tmp_path / "slide.txt")
+    r = _run(["examples/sliding_degree_sums.py", edge_file, out,
+              "200", "100"])
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = sorted(open(out).read().split())
+    # vertex 1's [0,200) window sums edges (1,2,100)+(1,3,150) = 250
+    assert "1,250" in lines
+
+
 def test_measurements_cli_degrees(edge_file):
     r = _run(["examples/measurements.py", "degrees", edge_file, "8"])
     assert r.returncode == 0, r.stderr[-500:]
