@@ -144,9 +144,11 @@ def section_window(results: dict) -> None:
         # kernel default — otherwise successive profiling runs ratchet
         # K downward and can never re-explore larger values
         default_kb = min(128, 2 * int(np.sqrt(eb)))
+        kernels = {}
         for kb in sorted({default_kb, default_kb // 2, default_kb // 4}):
             kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
                                         k_bucket=kb)
+            kernels[kern.kb] = kern
             # one instrumented pass counts the overflow recounts an
             # undersized K pays (and warms every program it needs),
             # then the clean timing runs uninstrumented
@@ -169,28 +171,46 @@ def section_window(results: dict) -> None:
                 "edges_per_s": round(num_w * eb / t),
                 "overflow_recounts_per_run": overflows[0],
             })
-        # chunk sweep (windows per dispatch) at the fastest measured K:
-        # on the tunneled chip each dispatch costs ~0.2s, so chunk size
+        # chunk sweep (windows per dispatch) at the fastest clean K: on
+        # the tunneled chip each dispatch costs ~0.2s, so chunk size
         # trades h2d size against dispatch amortization; on CPU it
-        # should be flat (dispatch ~free) — both facts worth pinning
+        # should be flat (dispatch ~free) — both facts worth pinning.
+        # The stream must have MORE windows than the largest chunk
+        # (128), else the biggest rows silently re-time the same single
+        # dispatch; reuse the k_sweep's already-compiled kernel.
         clean = [s for s in row["k_sweep"]
                  if s["overflow_recounts_per_run"] == 0]
         best_kb = min(clean or row["k_sweep"],
                       key=lambda s: s["per_window_ms"])["k_bucket"]
-        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
-                                    k_bucket=best_kb)
+        kern = kernels[best_kb]
+        cnum_w = 128
+        csrc, cdst = _stream(cnum_w * eb, vb, seed=8)
+        overflows = [0]
+        orig = kern.count
+
+        def counting(s, d, min_k=0):
+            overflows[0] += 1
+            return orig(s, d, min_k)
+
+        kern.count = counting
+        kern.count_stream(csrc, cdst)   # warm + count recounts once
+        kern.count = orig
+        row["chunk_sweep_k"] = best_kb
+        row["chunk_sweep_windows"] = cnum_w
+        row["chunk_sweep_overflow_recounts"] = overflows[0]
         row["chunk_sweep"] = []
         for cs in (32, 64, 128):
             kern.MAX_STREAM_WINDOWS = cs
-            kern.count_stream(src, dst)   # warm this chunk shape
-            t = _timeit(lambda: kern.count_stream(src, dst),
+            kern.count_stream(csrc, cdst)   # warm this chunk shape
+            t = _timeit(lambda: kern.count_stream(csrc, cdst),
                         reps=3, warmup=0)
             row["chunk_sweep"].append({
                 "windows_per_dispatch": cs,
                 "default": cs == TriangleWindowKernel.MAX_STREAM_WINDOWS,
-                "per_window_ms": round(t / num_w * 1e3, 3),
-                "edges_per_s": round(num_w * eb / t),
+                "per_window_ms": round(t / cnum_w * 1e3, 3),
+                "edges_per_s": round(cnum_w * eb / t),
             })
+        del kern.MAX_STREAM_WINDOWS   # restore the class default
         out.append(row)
     results["window"] = out
 

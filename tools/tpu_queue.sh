@@ -30,12 +30,15 @@ timeout 5400 python tools/profile_kernels.py >/tmp/tpuq_prof.log 2>/tmp/tpuq_pro
 prof_rc=$?
 echo "profile rc=$prof_rc"
 
-if [ "$prof_rc" -eq 0 ]; then
+# gate on what stage 3 actually consumes: a chip-labeled k-sweep in
+# the COMMITTED PERF.json (a CPU-fallback profile writes .partial only
+# and still exits 0)
+if [ "$prof_rc" -eq 0 ] && grep -q '"backend": "tpu"' PERF.json 2>/dev/null; then
   echo "=== stage 3: bench.py again (now reads the chip-tuned K from PERF.json) ==="
   timeout 5400 python bench.py >/tmp/tpuq_bench2.log 2>/tmp/tpuq_bench2.err
   echo "bench2 rc=$? ; $(tail -1 /tmp/tpuq_bench2.log 2>/dev/null)"
 else
-  echo "stage 3 skipped: no fresh k-sweep to consume (profile rc=$prof_rc)"
+  echo "stage 3 skipped: no chip-labeled k-sweep to consume (profile rc=$prof_rc)"
 fi
 
 echo "=== stage 4: scale_run (driver+fused on chip, sharded on cpu mesh) ==="
