@@ -400,3 +400,49 @@ def test_batched_scan_path_matches_per_window_path(sharded):
         np.testing.assert_array_equal(ra.degrees, rb.degrees)
         np.testing.assert_array_equal(ra.cc_labels, rb.cc_labels)
         np.testing.assert_array_equal(ra.bipartite_odd, rb.bipartite_odd)
+
+
+def test_stream_file_multi_crash_resume_fuzz(tmp_path):
+    """Repeated random crashes + resumes over one event-time file must
+    end in EXACTLY the uninterrupted run's carried state, regardless of
+    chunk sizes, checkpoint cadences, and kill points (the reference
+    delegates this whole axis to Flink; SURVEY.md §5.3-5.4)."""
+    for seed in (5, 17):
+        rng = np.random.default_rng(seed)
+        n = 1200
+        src = rng.integers(0, 120, n)
+        dst = rng.integers(0, 120, n)
+        ts = np.sort(rng.integers(0, 4000, n))
+        p = tmp_path / f"fuzz{seed}.txt"
+        p.write_text("".join(f"{s} {d} {t}\n"
+                             for s, d, t in zip(src, dst, ts)))
+        ck = str(tmp_path / f"fuzz{seed}.ckpt")
+
+        ref = StreamingAnalyticsDriver(window_ms=400)
+        ref.run_file(str(p))
+        want = ref.state_dict()
+
+        first = True
+        for attempt in range(50):
+            d = StreamingAnalyticsDriver(window_ms=400)
+            resumed = (not first) and d.try_resume(ck)
+            d.enable_auto_checkpoint(
+                ck, every_n_windows=int(rng.integers(1, 4)))
+            kill_after = int(rng.integers(1, 5))
+            finished = True
+            for i, _res in enumerate(d.stream_file(
+                    str(p), chunk_bytes=int(rng.integers(256, 4096)),
+                    resume=resumed)):
+                if i + 1 >= kill_after and rng.random() < 0.6:
+                    finished = False
+                    break
+            first = False
+            if finished:
+                break
+        assert finished, "fuzz never completed the stream in 50 attempts"
+
+        got = d.state_dict()
+        assert got["windows_done"] == want["windows_done"]
+        assert got["edges_done"] == want["edges_done"]
+        for key in ("vertex_ids", "degrees", "cc", "bip"):
+            np.testing.assert_array_equal(got[key], want[key], err_msg=key)

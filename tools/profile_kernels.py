@@ -108,6 +108,25 @@ def section_intersect(results: dict) -> None:
     }
 
 
+def _count_overflow_recounts(kern, src, dst) -> int:
+    """Run count_stream once with kern.count instrumented, returning
+    how many per-window exact recounts (K-bucket overflows) the stream
+    triggers; also warms every program the stream needs."""
+    overflows = [0]
+    orig = kern.count
+
+    def counting(s, d, min_k=0):
+        overflows[0] += 1
+        return orig(s, d, min_k)
+
+    kern.count = counting
+    try:
+        kern.count_stream(src, dst)
+    finally:
+        kern.count = orig
+    return overflows[0]
+
+
 def section_window(results: dict) -> None:
     """TriangleWindowKernel.count_stream: per-window latency and h2d
     bandwidth at three window sizes (64 windows each). The K×K
@@ -152,16 +171,7 @@ def section_window(results: dict) -> None:
             # one instrumented pass counts the overflow recounts an
             # undersized K pays (and warms every program it needs),
             # then the clean timing runs uninstrumented
-            overflows = [0]
-            orig = kern.count
-
-            def counting(s, d, min_k=0):
-                overflows[0] += 1
-                return orig(s, d, min_k)
-
-            kern.count = counting
-            kern.count_stream(src, dst)
-            kern.count = orig
+            overflow_count = _count_overflow_recounts(kern, src, dst)
             t = _timeit(lambda: kern.count_stream(src, dst),
                         reps=3, warmup=0)
             row["k_sweep"].append({
@@ -169,15 +179,17 @@ def section_window(results: dict) -> None:
                 "default": kern.kb == default_kb,
                 "per_window_ms": round(t / num_w * 1e3, 3),
                 "edges_per_s": round(num_w * eb / t),
-                "overflow_recounts_per_run": overflows[0],
+                "overflow_recounts_per_run": overflow_count,
             })
         # chunk sweep (windows per dispatch) at the fastest clean K: on
         # the tunneled chip each dispatch costs ~0.2s, so chunk size
         # trades h2d size against dispatch amortization; on CPU it
         # should be flat (dispatch ~free) — both facts worth pinning.
-        # The stream must have MORE windows than the largest chunk
-        # (128), else the biggest rows silently re-time the same single
-        # dispatch; reuse the k_sweep's already-compiled kernel.
+        # The stream needs AT LEAST as many windows as the largest
+        # chunk (128; equality suffices — cs=128 then times one full
+        # dispatch, cs=64 times two), else the biggest rows silently
+        # re-time the same dispatch; reuse the k_sweep's compiled
+        # kernel.
         clean = [s for s in row["k_sweep"]
                  if s["overflow_recounts_per_run"] == 0]
         best_kb = min(clean or row["k_sweep"],
@@ -185,19 +197,11 @@ def section_window(results: dict) -> None:
         kern = kernels[best_kb]
         cnum_w = 128
         csrc, cdst = _stream(cnum_w * eb, vb, seed=8)
-        overflows = [0]
-        orig = kern.count
-
-        def counting(s, d, min_k=0):
-            overflows[0] += 1
-            return orig(s, d, min_k)
-
-        kern.count = counting
-        kern.count_stream(csrc, cdst)   # warm + count recounts once
-        kern.count = orig
         row["chunk_sweep_k"] = best_kb
         row["chunk_sweep_windows"] = cnum_w
-        row["chunk_sweep_overflow_recounts"] = overflows[0]
+        # warms every needed program + counts recounts once
+        row["chunk_sweep_overflow_recounts"] = _count_overflow_recounts(
+            kern, csrc, cdst)
         row["chunk_sweep"] = []
         for cs in (32, 64, 128):
             kern.MAX_STREAM_WINDOWS = cs
