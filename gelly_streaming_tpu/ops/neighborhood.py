@@ -192,6 +192,35 @@ def _pane_identity(name: str, dtype):
     return big if name == "min" else -big
 
 
+def window_stack_combine(cells, counts, wp: int, name: str):
+    """Sliding-window combine from pane partials: normalize empty
+    (pane, vertex) cells to the monoid identity (segment_min/max fill
+    them with dtype extremes — coincides with the identity for ints,
+    NOT for floats), pad wp-1 identity rows on BOTH ends (window w
+    covers padded pane rows [w, w+wp-1], w = 0 .. P+wp-2), then
+    elementwise-combine the wp shifted slices. Shared by the
+    single-chip pane path and parallel/sharded.make_sharded_pane_reduce
+    — returns ([W, V] values, [W, V] counts), W = P + wp - 1."""
+    import jax.numpy as jnp
+
+    ident = _pane_identity(name, cells.dtype)
+    if name != "sum":
+        cells = jnp.where(counts > 0, cells, ident)
+    comb = {"sum": jnp.add, "min": jnp.minimum,
+            "max": jnp.maximum}[name]
+    cols = cells.shape[1]
+    pad_v = jnp.full((wp - 1, cols), ident, cells.dtype)
+    pad_c = jnp.zeros((wp - 1, cols), counts.dtype)
+    pv = jnp.concatenate([pad_v, cells, pad_v])
+    pc = jnp.concatenate([pad_c, counts, pad_c])
+    n_w = cells.shape[0] + wp - 1
+    accv, accc = pv[:n_w], pc[:n_w]
+    for k in range(1, wp):
+        accv = comb(accv, pv[k:k + n_w])
+        accc = accc + pc[k:k + n_w]
+    return accv, accc
+
+
 def _make_pane_reduce(name: str, per_window_kernel):
     """Sliding-window monoid reduce from slide-sized PANE partials: one
     device dispatch computes every window instead of re-reducing each
@@ -256,27 +285,12 @@ def _make_pane_reduce(name: str, per_window_kernel):
 
         vj = jnp.asarray(vpad)
         sj = jnp.asarray(segpad)
-        ident = _pane_identity(name, vj.dtype)
         counts = jax.ops.segment_sum(
             (sj < n_cells).astype(jnp.int32), sj,
             n_cells + 1)[:-1].reshape(pb, sb + 1)
         part = seg_ops.segment_reduce(vj, sj, n_cells + 1,
                                       name)[:-1].reshape(pb, sb + 1)
-        if name != "sum":
-            part = jnp.where(counts > 0, part, ident)
-        # pad wp-1 identity rows on BOTH ends: window w covers padded
-        # pane rows [w, w+wp-1], w = 0 .. pb+wp-2
-        pad_v = jnp.full((wp - 1, sb + 1), ident, part.dtype)
-        pad_c = jnp.zeros((wp - 1, sb + 1), counts.dtype)
-        pv = jnp.concatenate([pad_v, part, pad_v])
-        pc = jnp.concatenate([pad_c, counts, pad_c])
-        n_w = pb + wp - 1
-        comb = {"sum": jnp.add, "min": jnp.minimum,
-                "max": jnp.maximum}[name]
-        accv, accc = pv[:n_w], pc[:n_w]
-        for k in range(1, wp):
-            accv = comb(accv, pv[k:k + n_w])
-            accc = accc + pc[k:k + n_w]
+        accv, accc = window_stack_combine(part, counts, wp, name)
         accv, accc = np.asarray(accv), np.asarray(accc)
 
         # emit only occupied (window, vertex) cells, vectorized — a

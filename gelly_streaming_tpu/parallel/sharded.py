@@ -120,6 +120,62 @@ def make_sharded_triangle_fn(mesh):
 
 
 # ----------------------------------------------------------------------
+# sharded sliding-window pane reduce (P1 + P2 over (pane, vertex) cells)
+# ----------------------------------------------------------------------
+
+def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
+                             panes_per_window: int, name: str):
+    """Sliding-window monoid reduce at multi-chip scale — the sharded
+    form of the single-chip pane path (ops/neighborhood.py
+    _make_pane_reduce; see docs/DESIGN.md §1.1): edges sharded across
+    chips (P1), each shard segment-reduces its slice over flattened
+    (pane, vertex) cell ids into a full [pane_bucket, V+1] partial, ONE
+    collective (psum / pmin / pmax, P2) merges the partials, and every
+    window is a static stack of panes_per_window shifted pane slices
+    combined elementwise — all windows from one program, no edge
+    duplication.
+
+    Returns jitted fn(src, pane, val, valid) -> (win_vals, win_counts),
+    both [pane_bucket + panes_per_window - 1, vertex_bucket + 1]; a
+    (window, vertex) cell is meaningful iff win_counts[w, v] > 0
+    (min/max cells left at their identity otherwise). Window w covers
+    dense panes [w - panes_per_window + 1, w]; src/pane/val/valid are
+    edge-sharded arrays (pad with valid=False).
+    """
+    assert name in ("sum", "min", "max"), name
+    vbp = vertex_bucket + 1
+    pb = pane_bucket
+    wp = panes_per_window
+    n_cells = pb * vbp
+    coll = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+            "max": jax.lax.pmax}[name]
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                  P(SHARD_AXIS)),
+        out_specs=(P(), P()),
+    )
+    def partials(src, pane, val, valid):
+        ids = jnp.where(valid, pane * vbp + src, n_cells)
+        # segment_min/max leave empty cells at the dtype identity —
+        # exactly the pane-combine identity the window stack needs
+        cells = seg_ops.segment_reduce(val, ids, n_cells + 1,
+                                       name)[:-1].reshape(pb, vbp)
+        counts = jax.ops.segment_sum(
+            jnp.where(valid, 1, 0), ids, n_cells + 1)[:-1].reshape(pb, vbp)
+        return coll(cells, SHARD_AXIS), jax.lax.psum(counts, SHARD_AXIS)
+
+    def run(src, pane, val, valid):
+        from ..ops.neighborhood import window_stack_combine
+
+        cells, counts = partials(src, pane, val, valid)
+        return window_stack_combine(cells, counts, wp, name)
+
+    return jax.jit(run)
+
+
+# ----------------------------------------------------------------------
 # full sharded window triangle pipeline (P1 + P6: all_to_all + pmax + psum)
 # ----------------------------------------------------------------------
 
