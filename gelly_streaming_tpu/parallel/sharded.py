@@ -158,8 +158,10 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
     )
     def partials(src, pane, val, valid):
         ids = jnp.where(valid, pane * vbp + src, n_cells)
-        # segment_min/max leave empty cells at the dtype identity —
-        # exactly the pane-combine identity the window stack needs
+        # segment_min/max fill empty cells with dtype extremes (+/-inf
+        # for floats — NOT _pane_identity); per-shard fills absorb in
+        # pmin/pmax, and window_stack_combine re-normalizes globally
+        # empty (count==0) cells to the documented identity
         cells = seg_ops.segment_reduce(val, ids, n_cells + 1,
                                        name)[:-1].reshape(pb, vbp)
         counts = jax.ops.segment_sum(
