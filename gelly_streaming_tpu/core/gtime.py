@@ -35,6 +35,10 @@ class Time:
             "seconds": 1000,
             "min": 60_000,
             "minutes": 60_000,
+            "h": 3_600_000,
+            "hours": 3_600_000,
+            "d": 86_400_000,
+            "days": 86_400_000,
         }[unit]
         return Time(int(value) * factor)
 
@@ -45,6 +49,18 @@ class Time:
     @staticmethod
     def seconds(value: int) -> "Time":
         return Time(int(value) * 1000)
+
+    @staticmethod
+    def minutes(value: int) -> "Time":
+        return Time(int(value) * 60_000)
+
+    @staticmethod
+    def hours(value: int) -> "Time":
+        return Time(int(value) * 3_600_000)
+
+    @staticmethod
+    def days(value: int) -> "Time":
+        return Time(int(value) * 86_400_000)
 
 
 class Clock:

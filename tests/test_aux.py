@@ -107,3 +107,16 @@ def test_sharded_engine_checkpoint():
     eng2.load_state_dict(state)
     out = eng2.degrees(np.array([1]), np.array([2]))
     assert out[1] == 2 and out[2] == 3
+
+
+def test_time_units_complete():
+    """Flink Time surface: every unit form produces the same ms value
+    (reference: org.apache.flink.streaming.api.windowing.time.Time)."""
+    from gelly_streaming_tpu import Time
+
+    assert Time.of(2, "minutes").milliseconds == 120_000
+    assert Time.minutes(2).milliseconds == 120_000
+    assert Time.hours(1).milliseconds == Time.of(1, "h").milliseconds \
+        == 3_600_000
+    assert Time.days(1).milliseconds == Time.of(24, "hours").milliseconds
+    assert Time.seconds(3).milliseconds == Time.of(3000).milliseconds
