@@ -717,14 +717,36 @@ class StreamingAnalyticsDriver:
         self._ckpt_every = every_n_windows
 
     def try_resume(self, path: str) -> bool:
-        """Restore from `path` if a checkpoint exists; returns whether
-        state was restored. After resume, `windows_done` is the cursor
-        of fully-processed windows — feed the stream from there."""
+        """Restore from `path` if a readable checkpoint exists; returns
+        whether state was restored. After resume, `windows_done` is the
+        cursor of fully-processed windows — feed the stream from there.
+
+        An UNREADABLE file (truncated/corrupt — possible only through
+        external damage, since save() writes atomically via tmp+rename)
+        behaves like a missing checkpoint: warn and return False, so
+        the caller reprocesses from the start, which is always correct.
+        SEMANTIC mismatches (cross-mode, window size) still raise from
+        load_state_dict — those need an operator decision, not a silent
+        full reprocess — and so do OPERATIONAL failures (PermissionError
+        / EIO / out-of-memory): the file may be intact, and silently
+        reprocessing a multi-million-edge stream would mask a fixable
+        problem."""
         import os
+        import warnings
+        import zipfile
 
         if not os.path.exists(path):
             return False
-        self.load_state_dict(checkpoint.restore(path))
+        try:
+            state = checkpoint.restore(path)
+        except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
+            # the failure shapes np.load produces for truncated/corrupt
+            # archives and mangled payloads
+            warnings.warn(
+                f"checkpoint {path!r} is corrupt "
+                f"({type(e).__name__}: {e}); starting fresh")
+            return False
+        self.load_state_dict(state)
         return True
 
     def state_dict(self) -> dict:

@@ -446,3 +446,28 @@ def test_stream_file_multi_crash_resume_fuzz(tmp_path):
         assert got["edges_done"] == want["edges_done"]
         for key in ("vertex_ids", "degrees", "cc", "bip"):
             np.testing.assert_array_equal(got[key], want[key], err_msg=key)
+
+
+def test_try_resume_corrupt_checkpoint_starts_fresh(tmp_path):
+    """A truncated/corrupt checkpoint file (external damage — save()
+    itself is atomic) must behave like a missing one: warn, return
+    False, full reprocess stays correct. Semantic mismatches (e.g.
+    cross-mode) still raise — covered by
+    test_driver_cross_mode_checkpoint_refused."""
+    import warnings
+
+    from gelly_streaming_tpu.utils import checkpoint
+
+    d = StreamingAnalyticsDriver(window_ms=100)
+    d.run_arrays(np.array([1, 2, 3]), np.array([2, 3, 4]))
+    ck = str(tmp_path / "c.ckpt")
+    checkpoint.save(ck, d.state_dict())
+    raw = open(ck, "rb").read()
+    open(ck, "wb").write(raw[:len(raw) // 2])
+
+    e = StreamingAnalyticsDriver(window_ms=100)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert e.try_resume(ck) is False
+    assert any("corrupt" in str(w.message) for w in caught)
+    assert e.windows_done == 0  # clean fresh state
