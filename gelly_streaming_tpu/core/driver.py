@@ -734,14 +734,17 @@ class StreamingAnalyticsDriver:
         import os
         import warnings
         import zipfile
+        import zlib
 
         if not os.path.exists(path):
             return False
         try:
             state = checkpoint.restore(path)
-        except (zipfile.BadZipFile, ValueError, KeyError, EOFError) as e:
-            # the failure shapes np.load produces for truncated/corrupt
-            # archives and mangled payloads
+        except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
+                EOFError) as e:
+            # the failure shapes np.load produces for damaged archives:
+            # truncation -> BadZipFile/EOFError, bit-flipped deflate
+            # streams -> zlib.error, mangled payloads -> ValueError/KeyError
             warnings.warn(
                 f"checkpoint {path!r} is corrupt "
                 f"({type(e).__name__}: {e}); starting fresh")

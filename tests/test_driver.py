@@ -471,3 +471,37 @@ def test_try_resume_corrupt_checkpoint_starts_fresh(tmp_path):
         assert e.try_resume(ck) is False
     assert any("corrupt" in str(w.message) for w in caught)
     assert e.windows_done == 0  # clean fresh state
+
+    # bit-flip INSIDE the compressed payload (valid zip structure,
+    # mangled deflate stream -> zlib.error, a different failure shape
+    # than truncation's BadZipFile)
+    ck2 = str(tmp_path / "c2.ckpt")
+    checkpoint.save(ck2, d.state_dict())
+    raw2 = bytearray(open(ck2, "rb").read())
+    mid = len(raw2) // 2
+    raw2[mid] ^= 0xFF
+    raw2[mid + 1] ^= 0xFF
+    open(ck2, "wb").write(bytes(raw2))
+    f = StreamingAnalyticsDriver(window_ms=100)
+    with warnings.catch_warnings(record=True):
+        warnings.simplefilter("always")
+        assert f.try_resume(ck2) is False
+
+
+def test_stream_file_tolerates_malformed_lines(tmp_path):
+    """The ingest parser drops malformed lines (native and Python
+    fallbacks agree — tests/test_native.py pins that); the driver sees
+    only the valid records, and an all-garbage file behaves like an
+    empty one."""
+    g = tmp_path / "garbage.txt"
+    g.write_text("hello world\nfoo bar baz\n# comment\n")
+    d = StreamingAnalyticsDriver(window_ms=100)
+    assert list(d.stream_file(str(g))) == []
+    assert d.windows_done == 0
+
+    m = tmp_path / "mixed.txt"
+    m.write_text("x\n1 2 100\nbad line\n3 4 200\n")
+    e = StreamingAnalyticsDriver(window_ms=100)
+    res = list(e.stream_file(str(m)))
+    assert [(r.window_start, int(r.degrees.sum())) for r in res] == \
+        [(100, 2), (200, 4)]
