@@ -92,3 +92,55 @@ def test_sampling_estimator_deterministic():
         return env.results_of(sink)
 
     assert run() == run()
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_weighted_matching_invariants_random(seed):
+    """Random streams: the surviving ADD-set must be a valid matching
+    (no shared endpoints) whose weight is >= 1/6 of the brute-force
+    optimum — the guarantee of the 2x-threshold preemptive greedy the
+    reference implements (keep iff weight > 2 * sum of colliding
+    matched weights, CentralizedWeightedMatching.java:68-108; the
+    folklore 1/2 bound belongs to a different greedy — e.g. stream
+    [(0,1,10), (2,0,19), (1,3,19)] keeps only weight 10 vs optimum
+    38)."""
+    rng = np.random.default_rng(seed)
+    v = 8
+    edges = []
+    for _ in range(25):
+        a, b = rng.choice(v, size=2, replace=False)
+        edges.append(Edge(int(a), int(b), int(rng.integers(1, 100))))
+
+    env = StreamEnvironment()
+    sink = centralized_weighted_matching(env.from_collection(edges)).collect()
+    env.execute()
+    matched = {}
+    for ev in env.results_of(sink):
+        key = (ev.edge.source, ev.edge.target)
+        if ev.type == MatchingEventType.ADD:
+            matched[key] = ev.edge.value
+        else:
+            matched.pop(key, None)
+    # validity: no vertex in two matched edges
+    used = [x for (s, t) in matched for x in (s, t)]
+    assert len(used) == len(set(used)), matched
+    got = sum(matched.values())
+
+    # brute-force optimum over all subsets of distinct edges (dedupe
+    # parallel edges keeping max weight; 25 edges over 8 vertices ->
+    # <= 28 distinct pairs, optimum found over vertex-disjoint subsets
+    # via simple DP on bitmask of used vertices)
+    best_w = {}
+    for e in edges:
+        k = tuple(sorted((e.source, e.target)))
+        best_w[k] = max(best_w.get(k, 0), e.value)
+    items = [(1 << a | 1 << b, w) for (a, b), w in best_w.items()]
+    best = {0: 0}
+    for mask, w in items:
+        for used_mask, tot in list(best.items()):
+            if not (used_mask & mask):
+                nm = used_mask | mask
+                if best.get(nm, -1) < tot + w:
+                    best[nm] = tot + w
+    opt = max(best.values())
+    assert 6 * got >= opt, (got, opt)
