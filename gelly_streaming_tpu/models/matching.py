@@ -1,11 +1,16 @@
-"""Centralized greedy weighted matching (1/2-approximation).
+"""Centralized preemptive greedy weighted matching.
 
 Counterpart of the reference's CentralizedWeightedMatching
 (example/CentralizedWeightedMatching.java:56-108): a parallelism-1
 sequential stage (parallelism strategy P4, SURVEY.md §2.4) that keeps a
 local matching; an arriving edge replaces its colliding matched edges
-iff its weight exceeds twice their summed weight, emitting ADD/REMOVE
-events. Inherently sequential — this stays a host stage by design; the
+iff its weight exceeds TWICE their summed weight, emitting ADD/REMOVE
+events. This 2x-threshold preemptive greedy (Feigenbaum et al.'s
+streaming matching) guarantees a 1/6-approximation in the worst case —
+NOT the folklore 1/2 of offline greedy: a kept edge flanked by two
+just-under-threshold rivals shows the gap (pinned with a counterexample
+in tests/library/test_workloads.py::test_weighted_matching_invariants_
+random). Inherently sequential — this stays a host stage by design; the
 endpoint-collision lookup uses a dict index instead of the reference's
 full-set scan.
 """

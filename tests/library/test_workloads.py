@@ -95,7 +95,7 @@ def test_sampling_estimator_deterministic():
 
 
 @pytest.mark.parametrize("seed", range(5))
-def test_weighted_matching_invariants_random(seed):
+def test_weighted_matching_invariants_random(env, seed):
     """Random streams: the surviving ADD-set must be a valid matching
     (no shared endpoints) whose weight is >= 1/6 of the brute-force
     optimum — the guarantee of the 2x-threshold preemptive greedy the
@@ -111,7 +111,6 @@ def test_weighted_matching_invariants_random(seed):
         a, b = rng.choice(v, size=2, replace=False)
         edges.append(Edge(int(a), int(b), int(rng.integers(1, 100))))
 
-    env = StreamEnvironment()
     sink = centralized_weighted_matching(env.from_collection(edges)).collect()
     env.execute()
     matched = {}
@@ -120,7 +119,8 @@ def test_weighted_matching_invariants_random(seed):
         if ev.type == MatchingEventType.ADD:
             matched[key] = ev.edge.value
         else:
-            matched.pop(key, None)
+            # a REMOVE for a never-ADDed edge is a protocol bug
+            matched.pop(key)
     # validity: no vertex in two matched edges
     used = [x for (s, t) in matched for x in (s, t)]
     assert len(used) == len(set(used)), matched

@@ -72,3 +72,20 @@ def test_measurements_cli_degrees(edge_file):
 
     row = json.loads(r.stdout.strip().splitlines()[-1])
     assert row["workload"] == "degrees" and row["edges"] == 6
+
+
+@pytest.mark.parametrize("cli", [
+    "iterative_connected_components",
+    "broadcast_triangle_count",
+    "incidence_sampling_triangle_count",
+    "centralized_weighted_matching",
+    "degree_aggregate",
+    "streaming_analytics",
+])
+def test_remaining_clis_run_with_defaults(cli):
+    """Every example CLI must at least run its built-in default data
+    end-to-end (argument-surface regressions fail loudly here; the
+    deeper output checks live in the per-workload tests above and in
+    tests/library/)."""
+    r = _run([f"examples/{cli}.py"])
+    assert r.returncode == 0, (cli, r.stderr[-500:])
