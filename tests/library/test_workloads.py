@@ -144,3 +144,25 @@ def test_weighted_matching_invariants_random(env, seed):
                     best[nm] = tot + w
     opt = max(best.values())
     assert 6 * got >= opt, (got, opt)
+
+
+def test_weighted_matching_counterexample_to_half(env):
+    """The concrete stream showing the 2x-threshold preemptive greedy
+    is NOT a 1/2-approximation (cited by models/matching.py's
+    docstring): both weight-19 rivals fail the >2x test against the
+    kept weight-10 edge, so the final matching is 10 vs optimum 38 —
+    below 1/2, above 1/6."""
+    edges = [Edge(0, 1, 10), Edge(2, 0, 19), Edge(1, 3, 19)]
+    sink = centralized_weighted_matching(env.from_collection(edges)).collect()
+    env.execute()
+    matched = {}
+    for ev in env.results_of(sink):
+        key = (ev.edge.source, ev.edge.target)
+        if ev.type == MatchingEventType.ADD:
+            matched[key] = ev.edge.value
+        else:
+            matched.pop(key)
+    got, opt = sum(matched.values()), 38
+    assert matched == {(0, 1): 10}
+    assert 2 * got < opt        # refutes the 1/2 claim
+    assert 6 * got >= opt       # within the real 1/6 bound
