@@ -8,11 +8,11 @@ iff its weight exceeds TWICE their summed weight, emitting ADD/REMOVE
 events. This 2x-threshold preemptive greedy (Feigenbaum et al.'s
 streaming matching) guarantees a 1/6-approximation in the worst case —
 NOT the folklore 1/2 of offline greedy: a kept edge flanked by two
-just-under-threshold rivals shows the gap (pinned with a counterexample
-in tests/library/test_workloads.py::test_weighted_matching_invariants_
-random). Inherently sequential — this stays a host stage by design; the
-endpoint-collision lookup uses a dict index instead of the reference's
-full-set scan.
+just-under-threshold rivals shows the gap (pinned with an executed
+counterexample: tests/library/test_workloads.py::
+test_weighted_matching_counterexample_to_half). Inherently sequential —
+this stays a host stage by design; the endpoint-collision lookup uses
+a dict index instead of the reference's full-set scan.
 """
 
 from __future__ import annotations

@@ -148,8 +148,7 @@ def test_weighted_matching_invariants_random(env, seed):
 
 def test_weighted_matching_counterexample_to_half(env):
     """The concrete stream showing the 2x-threshold preemptive greedy
-    is NOT a 1/2-approximation (cited by models/matching.py's
-    docstring): both weight-19 rivals fail the >2x test against the
+    is NOT a 1/2-approximation: both weight-19 rivals fail the >2x test against the
     kept weight-10 edge, so the final matching is 10 vs optimum 38 —
     below 1/2, above 1/6."""
     edges = [Edge(0, 1, 10), Edge(2, 0, 19), Edge(1, 3, 19)]

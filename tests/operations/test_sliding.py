@@ -105,9 +105,13 @@ def test_sliding_pane_fallback_matches(env, monkeypatch):
     assert run_and_sort(env, out) == SLIDING_SUM
 
 
-def test_sliding_random_parity_host_vs_pane(env):
+@pytest.mark.parametrize("direction", [EdgeDirection.OUT,
+                                       EdgeDirection.IN,
+                                       EdgeDirection.ALL])
+def test_sliding_random_parity_host_vs_pane(env, direction):
     """Random stream: pane path == host reference semantics across a
-    ragged pane axis with gaps."""
+    ragged pane axis with gaps, in every edge direction (IN reverses
+    the stream, ALL doubles it — both upstream of the pane grouping)."""
     rng = np.random.default_rng(7)
     edges = []
     t = 0
@@ -117,12 +121,12 @@ def test_sliding_random_parity_host_vs_pane(env):
                           int(rng.integers(0, 12)), t))
     size, slide = Time.milliseconds_of(400), Time.milliseconds_of(100)
 
-    host = _graph(env, edges).slice(size, EdgeDirection.OUT, slide=slide) \
+    host = _graph(env, edges).slice(size, direction, slide=slide) \
         .reduce_on_edges(EdgesReduce(lambda a, b: min(a, b)))
     want = run_and_sort(env, host)
 
     env2 = type(env)(clock=env.clock)
-    dev = _graph(env2, edges).slice(size, EdgeDirection.OUT, slide=slide) \
+    dev = _graph(env2, edges).slice(size, direction, slide=slide) \
         .reduce_on_edges(JaxEdgesReduce(name="min"))
     assert run_and_sort(env2, dev) == want
 

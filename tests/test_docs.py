@@ -46,11 +46,11 @@ def _exists_somewhere(path: str) -> bool:
 def test_doc_file_citations_resolve():
     bad = []
     for doc in DOCS:
-        text = open(os.path.join(REPO, doc)).read()
+        text = open(os.path.join(REPO, doc), encoding="utf-8").read()
         cited = set(re.findall(
             r"`([A-Za-z_][A-Za-z0-9_/.]*\.(?:py|sh|md|json|cpp))`", text))
-        cited |= set(re.findall(r"\b(tests/[a-z_/]+\.py)\b", text))
-        cited |= set(re.findall(r"\b(test_[a-z_]+\.py)\b", text))
+        cited |= set(re.findall(r"\b(tests/[a-z0-9_/]+\.py)\b", text))
+        cited |= set(re.findall(r"\b(test_[a-z0-9_]+\.py)\b", text))
         for c in sorted(cited):
             # driver-produced per-round artifacts may not exist yet
             # (BENCH_r02.json lands at end of round)
@@ -64,5 +64,6 @@ def test_doc_file_citations_resolve():
 
 def test_doc_symbol_citations_resolve():
     bad = [(f, sym) for f, sym in DOC_SYMBOLS
-           if sym not in open(os.path.join(REPO, f)).read()]
+           if sym not in open(os.path.join(REPO, f),
+                              encoding="utf-8").read()]
     assert not bad, bad
