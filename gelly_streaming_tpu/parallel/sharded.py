@@ -481,6 +481,8 @@ class ShardedWindowEngine:
         # (ops/unionfind.bipartite_labels, sharded): built lazily
         self._bip_fn = None
         self._bip_labels = None
+        # sliding pane-reduce programs, per (pane_bucket, wp, monoid)
+        self._pane_fns = {}
 
     def reset(self) -> None:
         """Clear carried analytics state; compiled programs are kept, so
@@ -565,14 +567,57 @@ class ShardedWindowEngine:
         self._bip_labels = (jnp.asarray(state["bip_labels"])
                             if "bip_labels" in state else None)
 
+    @staticmethod
+    def _pad_mesh_arrays(target: int, *arr_fill_pairs):
+        """Pad each (array, fill) pair to `target` — the shared
+        pad-to-mesh idiom of triangles() and sliding_reduce()."""
+        return [seg_ops.pad_to(a, target, fill=f)
+                for a, f in arr_fill_pairs]
+
     def triangles(self, nbr, ea, eb, emask) -> int:
         target = mesh_padded_len(len(ea), self.mesh)
         sentinel = nbr.shape[0] - 1
-        ea = seg_ops.pad_to(np.asarray(ea, np.int32), target, fill=sentinel)
-        eb = seg_ops.pad_to(np.asarray(eb, np.int32), target, fill=sentinel)
-        emask = seg_ops.pad_to(np.asarray(emask, bool), target, fill=False)
+        ea, eb, emask = self._pad_mesh_arrays(
+            target,
+            (np.asarray(ea, np.int32), sentinel),
+            (np.asarray(eb, np.int32), sentinel),
+            (np.asarray(emask, bool), False))
         return int(self.tri_fn(jnp.asarray(nbr), jnp.asarray(ea),
                                jnp.asarray(eb), jnp.asarray(emask)))
+
+    def sliding_reduce(self, src, pane, val, num_panes: int,
+                       panes_per_window: int, name: str = "sum"):
+        """Sliding-window monoid reduce over the mesh (the engine form
+        of make_sharded_pane_reduce; docs/DESIGN.md §1.1): `pane` gives
+        each edge's dense slide-index, windows cover panes_per_window
+        consecutive panes. Returns numpy (win_vals, win_counts), both
+        [pane_bucket + panes_per_window - 1, vb + 1]; a (w, v) cell is
+        meaningful iff win_counts[w, v] > 0, window w covering panes
+        [w - panes_per_window + 1, w]. Programs are cached per
+        (pane_bucket, panes_per_window, monoid), so steady-state
+        streaming pays zero recompilation."""
+        pb = seg_ops.bucket_size(num_panes)
+        key = (pb, panes_per_window, name)
+        fn = self._pane_fns.get(key)
+        if fn is None:
+            fn = make_sharded_pane_reduce(self.mesh, self.vb, pb,
+                                          panes_per_window, name)
+            self._pane_fns[key] = fn
+        src = np.asarray(src, np.int32)
+        pane = np.asarray(pane, np.int32)
+        val = np.asarray(val)
+        n = len(src)
+        # power-of-two edge bucket FIRST, then the mesh multiple:
+        # varying window edge counts reuse O(log E) compiled programs
+        # (the docstring's zero-steady-state-recompilation claim) —
+        # padded lanes are valid=False, routed to the trash cell
+        target = mesh_padded_len(seg_ops.bucket_size(n), self.mesh)
+        src, pane, val, valid = self._pad_mesh_arrays(
+            target, (src, 0), (pane, 0), (val, 0),
+            (np.ones(n, bool), False))
+        wv, wc = fn(jnp.asarray(src), jnp.asarray(pane),
+                    jnp.asarray(val), jnp.asarray(valid))
+        return np.asarray(wv), np.asarray(wc)
 
 
 # ----------------------------------------------------------------------

@@ -359,3 +359,38 @@ def test_sharded_pane_reduce_matches_numpy(name, dtype):
                     _pane_identity
                 assert got_v[w, v] == _pane_identity(
                     name, got_v.dtype), (name, w, v)
+
+
+def test_engine_sliding_reduce_matches_loose_fn():
+    """ShardedWindowEngine.sliding_reduce == the loose
+    make_sharded_pane_reduce it wraps (padding to shard multiples,
+    pane bucketing, program caching)."""
+    from gelly_streaming_tpu.parallel.sharded import (
+        ShardedWindowEngine, make_sharded_pane_reduce)
+
+    mesh = make_mesh()
+    n = shard_count(mesh)
+    eng = ShardedWindowEngine(mesh, num_vertices_bucket=32)
+    rng = np.random.default_rng(21)
+    e = 7 * n + 3   # deliberately NOT a shard multiple
+    src = rng.integers(0, 32, e).astype(np.int32)
+    pane = rng.integers(0, 5, e).astype(np.int32)
+    val = rng.integers(1, 50, e).astype(np.int32)
+    wv, wc = eng.sliding_reduce(src, pane, val, num_panes=5,
+                                panes_per_window=3, name="sum")
+    # second call reuses the cached program
+    wv2, wc2 = eng.sliding_reduce(src, pane, val, num_panes=5,
+                                  panes_per_window=3, name="sum")
+    np.testing.assert_array_equal(wv, wv2)
+    assert len(eng._pane_fns) == 1
+
+    pb = seg_ops.bucket_size(5)
+    fn = make_sharded_pane_reduce(mesh, 32, pb, 3, "sum")
+    pad = (-e) % n
+    s2 = np.concatenate([src, np.zeros(pad, np.int32)])
+    p2 = np.concatenate([pane, np.zeros(pad, np.int32)])
+    v2 = np.concatenate([val, np.zeros(pad, np.int32)])
+    m2 = np.concatenate([np.ones(e, bool), np.zeros(pad, bool)])
+    ev, ec = (np.asarray(x) for x in fn(s2, p2, v2, m2))
+    np.testing.assert_array_equal(wv, ev)
+    np.testing.assert_array_equal(wc, ec)
