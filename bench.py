@@ -9,16 +9,17 @@ throughput of the streaming device pipeline
 windows; the host ships only raw COO arrays).
 
 Baseline (BASELINE.md: "run the Flink reference or a faithful CPU
-port"): a faithful CPU port of the reference's candidate-pair pipeline
+port"): faithful CPU ports of the reference's candidate-pair pipeline
 (GenerateCandidateEdges + CountTriangles, WindowTriangles.java:83-140)
-measured on a sample of the same stream. The CPU port runs on smaller
-windows than the device (its O(d²) candidate generation is intractable
-at the device's window size — hub degree grows with window length), so
-the reported ratio is CONSERVATIVE: per-edge work grows superlinearly
-with window size for both paths.
+on the same stream. The PRIMARY baseline is a numpy-vectorized port
+(same O(d²) candidate algorithm, compiled inner loops — a fair proxy
+for the JVM comparator) timed at the device's own window size; the
+pure-Python dict/set port is kept as a secondary row (it measures
+CPython interpreter overhead as much as the algorithm).
 
-Exact-count parity between both paths is asserted on the shared sample
-windows before anything is timed.
+Exact-count parity between all paths is asserted on the shared sample
+windows (and the leading device-size windows) before anything is
+reported.
 
 Prints one JSON line per completed scale (smallest first), so an
 external timeout still leaves the best completed number; the LAST line
@@ -198,7 +199,10 @@ def warmup_stream_shapes(kernel, num_edges):
 def cpu_reference_window_counts(src, dst, window_edges):
     """Faithful CPU port of the reference pipeline: per-vertex ALL-window
     neighborhoods → candidate pairs (ids > vertex) → per-pair groups →
-    count candidates where a real edge exists."""
+    count candidates where a real edge exists. On self-looped input its
+    self-pair candidates mirror the reference's HashSet-order-dependent
+    emission (see _numpy_window_count), so parity across ports is
+    asserted only on loop-free streams — which every bench stream is."""
     counts = []
     for start in range(0, len(src), window_edges):
         s = src[start:start + window_edges]
@@ -225,6 +229,87 @@ def cpu_reference_window_counts(src, dst, window_edges):
     return counts
 
 
+def _numpy_window_count(s: np.ndarray, d: np.ndarray) -> int:
+    """One window of the faithful candidate-pair algorithm
+    (WindowTriangles.java:83-140), numpy-vectorized: same O(d²)
+    candidate generation per vertex, but with compiled inner loops so
+    the baseline is the ALGORITHM's cost, not CPython interpreter
+    overhead. Semantics match cpu_reference_window_counts on
+    SELF-LOOP-FREE streams (asserted at bench time; every bench stream
+    is loop-free by construction): for each center vertex, every
+    unordered pair of distinct neighbors both > center is a candidate,
+    counted once per center; candidates that are real edges sum to the
+    window's triangle count. Self-loops are stripped here — the
+    reference's own i==j self-pair emission depends on Java HashSet
+    iteration order (GenerateCandidateEdges skips the LAST-iterated
+    neighbor's self-pair), so its looped-input count is
+    nondeterministic and parity there is undefined; the device kernels
+    strip self-loops for the same reason."""
+    keep_e = s != d
+    s, d = s[keep_e], d[keep_e]
+    if len(s) == 0:
+        return 0
+    V = int(max(s.max(), d.max())) + 1
+    center = np.concatenate([s, d]).astype(np.int64)
+    nbr = np.concatenate([d, s]).astype(np.int64)
+    # distinct (center, neighbor) incidences, both directions = the
+    # port's `real` set and its deduped neighborhoods in one array
+    enc_u = np.unique(center * V + nbr)
+    c = enc_u // V
+    n = enc_u % V
+    keep = n > c
+    ck, nk = c[keep], n[keep]
+    if len(ck) == 0:
+        return 0
+    # per-center segments (ck is sorted because enc_u is)
+    change = np.flatnonzero(np.diff(ck)) + 1
+    offs = np.concatenate(([0], change, [len(ck)]))
+    k = np.diff(offs)
+    pairs_per_seg = k * (k - 1) // 2
+    cum = np.cumsum(pairs_per_seg)
+    total = 0
+    # batch segments so the pair arrays stay bounded in memory; hub
+    # vertices at 32K-edge windows generate tens of millions of pairs
+    MAX_PAIRS = 8_000_000
+    start_seg = 0
+    while start_seg < len(k):
+        base = int(cum[start_seg - 1]) if start_seg else 0
+        end_seg = int(np.searchsorted(cum, base + MAX_PAIRS,
+                                      side="right"))
+        end_seg = min(max(end_seg, start_seg + 1), len(k))
+        kb = k[start_seg:end_seg]
+        nb = nk[offs[start_seg]:offs[end_seg]]
+        kb_offs = np.concatenate(([0], np.cumsum(kb)))
+        # position of each element within its segment; element at
+        # position p is the SECOND member of p pairs (one per earlier
+        # element), which unrolls every i<j pair without a Python loop
+        pos = np.arange(len(nb)) - np.repeat(kb_offs[:-1], kb)
+        P = int(pos.sum())
+        if P:
+            j_idx = np.repeat(np.arange(len(nb)), pos)
+            blk = np.concatenate(([0], np.cumsum(pos)[:-1]))
+            i_off = np.arange(P) - np.repeat(blk, pos)
+            i_idx = np.repeat(kb_offs[:-1], kb)[j_idx] + i_off
+            pe = nb[i_idx] * V + nb[j_idx]
+            loc = np.searchsorted(enc_u, pe)
+            loc[loc >= len(enc_u)] = len(enc_u) - 1
+            total += int((enc_u[loc] == pe).sum())
+        start_seg = end_seg
+    return total
+
+
+def cpu_reference_window_counts_numpy(src, dst, window_edges):
+    """Numpy-vectorized faithful port (primary CPU baseline; the
+    pure-Python dict/set port above is kept as the secondary row —
+    VERDICT r2 weak-2: an interpreted baseline softens the ≥10× bar
+    because the real comparator is Flink's JVM, not CPython)."""
+    return [
+        _numpy_window_count(np.asarray(src[s:s + window_edges]),
+                            np.asarray(dst[s:s + window_edges]))
+        for s in range(0, len(src), window_edges)
+    ]
+
+
 def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
@@ -247,18 +332,21 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     # window_edges is not a power of two round up)
     window_edges = kernel.eb
 
-    # correctness cross-check + CPU baseline on shared sample windows
-    # (small enough for the O(d²) candidate pipeline to finish; four
-    # windows rather than two — the baseline is pure-Python dict/set
-    # churn whose per-window time swings with host load, and it sits in
-    # the denominator of the headline ratio, so averaging more windows
-    # costs ~1s and visibly steadies vs_baseline between runs)
+    # correctness cross-check + CPU baselines on shared sample windows
+    # (small enough for the O(d²) interpreted pipeline to finish; four
+    # windows — the ports' per-window time swings with host load and
+    # sits in the denominator of the ratio, so averaging steadies it)
     sample_window = min(window_edges, 8_192)
     sample = 4 * sample_window
     t0 = time.perf_counter()
     ref_counts = cpu_reference_window_counts(
         src[:sample], dst[:sample], sample_window)
-    cpu_rate = sample / (time.perf_counter() - t0)
+    cpu_py_rate = sample / (time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    np_counts = cpu_reference_window_counts_numpy(
+        src[:sample], dst[:sample], sample_window)
+    cpu_np_sample_rate = sample / (time.perf_counter() - t0)
+    assert np_counts == ref_counts, (np_counts, ref_counts)
     # parity of BOTH device paths: the per-window escalating kernel and
     # the batched lax.map streaming path the timed run uses
     dev_counts = [
@@ -271,12 +359,32 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     stream_counts = sample_kernel.count_stream(src[:sample], dst[:sample])
     assert stream_counts == ref_counts, (stream_counts, ref_counts)
 
+    # PRIMARY baseline: the numpy-vectorized faithful port timed at the
+    # DEVICE's window size, so the headline ratio compares like against
+    # like (the old sample-window/device-window asymmetry was argued
+    # conservative but never measured)
+    if window_edges == sample_window:
+        # the sample windows ARE device-size windows: reuse that
+        # measurement instead of timing the identical work twice
+        nfull, full_counts, cpu_rate = 4, np_counts, cpu_np_sample_rate
+    else:
+        nfull = max(1, min(4, num_edges // window_edges))
+        t0 = time.perf_counter()
+        full_counts = cpu_reference_window_counts_numpy(
+            src[:nfull * window_edges], dst[:nfull * window_edges],
+            window_edges)
+        cpu_rate = nfull * window_edges / (time.perf_counter() - t0)
+
     # warmup at the exact chunk shapes of the timed run (compile here)
     warmup_stream_shapes(kernel, num_edges)
     t0 = time.perf_counter()
-    device_window_counts(kernel, src, dst, window_edges)
+    timed_counts = device_window_counts(kernel, src, dst, window_edges)
     elapsed = time.perf_counter() - t0
     rate = num_edges / elapsed
+    # full-window-size parity: the timed device counts vs the primary
+    # baseline's counts on the shared leading windows
+    assert list(timed_counts[:nfull]) == full_counts, (
+        list(timed_counts[:nfull]), full_counts)
 
     print(json.dumps({
         "metric": "edges/sec/chip, exact window triangle count "
@@ -285,10 +393,18 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
         "value": round(rate),
         "unit": "edges/s",
         "vs_baseline": round(rate / cpu_rate, 2),
-        # the measured baseline itself, persisted (BASELINE.md milestone:
-        # faithful CPU port of WindowTriangles.java:83-140 on the same
-        # stream; the reference publishes no numbers of its own)
+        # the measured baselines, persisted (BASELINE.md milestone:
+        # faithful CPU ports of WindowTriangles.java:83-140 on the same
+        # stream; the reference publishes no numbers of its own).
+        # PRIMARY: numpy-vectorized port at the device's window size.
         "baseline_cpu_edges_per_s": round(cpu_rate),
+        # secondary rows: the same vectorized port on the sample
+        # windows, and the pure-Python dict/set port (interpreter-bound;
+        # kept for continuity with rounds 1-2)
+        "baseline_cpu_numpy_sample_edges_per_s":
+            round(cpu_np_sample_rate),
+        "baseline_cpu_python_edges_per_s": round(cpu_py_rate),
+        "vs_python_baseline": round(rate / cpu_py_rate, 2),
         "num_edges": num_edges,
     }), flush=True)
 

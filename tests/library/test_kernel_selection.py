@@ -1,0 +1,118 @@
+"""The measurement-driven kernel selections, tested end-to-end on
+synthetic PERF.json files (VERDICT r2 item 5: the selection framework
+must itself be under test so a committed chip profile provably flips
+the defaults).
+
+Covers the three selectors in ops/triangles.py:
+  - resolve_intersect_impl (Pallas fused-tile vs XLA winner)
+  - _resolve_dense_choice (Pallas fused contraction vs XLA matmul)
+  - _tuned_kb (k-sweep-driven starting K per edge bucket)
+and the backend-matching guards of _load_matching_perf (a cpu-labeled
+file must never drive a chip selection and vice versa).
+"""
+
+import json
+
+import jax
+import pytest
+
+from gelly_streaming_tpu.ops import triangles
+from gelly_streaming_tpu.ops.pallas_intersect import intersect_local_pallas
+from gelly_streaming_tpu.ops.triangles import DENSE_LIMIT
+
+
+@pytest.fixture
+def selection_env(tmp_path, monkeypatch):
+    """Redirect the selectors at a writable PERF.json, reset their
+    once-per-process caches, and let the test pick the apparent
+    backend. Restores everything afterwards."""
+    perf_path = tmp_path / "PERF.json"
+    monkeypatch.setattr(triangles, "_PERF_PATH", str(perf_path))
+    monkeypatch.setattr(triangles, "_INTERSECT_CHOICE", None)
+    monkeypatch.setattr(triangles, "_INTERSECT_JIT", None)
+    monkeypatch.setattr(triangles, "_DENSE_CHOICE", None)
+    monkeypatch.setattr(triangles, "_TUNED_KB", {})
+
+    def configure(file_backend, process_backend, **sections):
+        perf_path.write_text(
+            json.dumps(dict({"backend": file_backend}, **sections)))
+        monkeypatch.setattr(jax, "default_backend",
+                            lambda: process_backend)
+
+    return configure
+
+
+INTERSECT_WIN = {"parity_pallas": True, "pallas_vs_xla_compare": 1.20}
+DENSE_WIN = [{"num_vertices": 1024, "pallas_speedup": 1.10},
+             {"num_vertices": 2048, "pallas_speedup": 1.07}]
+
+
+def test_intersect_flips_to_pallas_on_winning_chip_rows(selection_env):
+    selection_env("tpu", "tpu", intersect=INTERSECT_WIN)
+    assert triangles.resolve_intersect_impl() is intersect_local_pallas
+
+
+@pytest.mark.parametrize("row", [
+    {"parity_pallas": True, "pallas_vs_xla_compare": 1.02},  # < 5% win
+    {"parity_pallas": False, "pallas_vs_xla_compare": 9.9},  # no parity
+    {},                                                      # no data
+])
+def test_intersect_keeps_xla_compare_without_a_clean_win(
+        selection_env, row):
+    selection_env("tpu", "tpu", intersect=row)
+    assert triangles.resolve_intersect_impl() is triangles.intersect_local
+
+
+def test_intersect_ignores_cpu_labeled_file_on_chip(selection_env):
+    # the same winning rows, recorded on the wrong backend: no flip
+    selection_env("cpu", "tpu", intersect=INTERSECT_WIN)
+    assert triangles.resolve_intersect_impl() is triangles.intersect_local
+
+
+def test_intersect_on_cpu_stays_bsearch_despite_chip_rows(selection_env):
+    # chip-only selection: a cpu process keeps its measured XLA winner
+    selection_env("tpu", "cpu", intersect=INTERSECT_WIN)
+    assert (triangles.resolve_intersect_impl()
+            is triangles.intersect_local_bsearch)
+
+
+def test_dense_flips_to_pallas_and_doubles_limit(selection_env):
+    selection_env("tpu", "tpu", dense=DENSE_WIN)
+    assert triangles._resolve_dense_choice() == ("pallas", 2 * DENSE_LIMIT)
+
+
+def test_dense_requires_a_win_at_every_measured_v(selection_env):
+    selection_env("tpu", "tpu", dense=DENSE_WIN + [
+        {"num_vertices": 4096, "pallas_speedup": 1.01}])
+    assert triangles._resolve_dense_choice() == ("xla", DENSE_LIMIT)
+
+
+def test_dense_ignores_error_stub_sections(selection_env):
+    # a failed profiler section records {"error": ...}; consumers must
+    # see no rows, not crash or select on garbage
+    selection_env("tpu", "tpu", dense={"error": "timeout"})
+    assert triangles._resolve_dense_choice() == ("xla", DENSE_LIMIT)
+
+
+def test_tuned_kb_reads_matching_backend_sweep(selection_env):
+    selection_env("cpu", "cpu", window=[{
+        "edge_bucket": 8192,
+        "k_sweep": [
+            {"k_bucket": 32, "per_window_ms": 3.0,
+             "overflow_recounts_per_run": 0},
+            {"k_bucket": 64, "per_window_ms": 5.0,
+             "overflow_recounts_per_run": 0},
+            # fastest row, but it overflowed: excluded
+            {"k_bucket": 16, "per_window_ms": 1.0,
+             "overflow_recounts_per_run": 2},
+        ]}])
+    assert triangles._tuned_kb(8192) == 32
+
+
+def test_tuned_kb_falls_back_to_analytic_on_backend_mismatch(
+        selection_env):
+    selection_env("tpu", "cpu", window=[{
+        "edge_bucket": 8192,
+        "k_sweep": [{"k_bucket": 32, "per_window_ms": 3.0,
+                     "overflow_recounts_per_run": 0}]}])
+    assert triangles._tuned_kb(8192) == min(128, 2 * int(8192 ** 0.5))

@@ -385,3 +385,19 @@ def test_kernels_empty_and_tiny():
     assert tri_ops.triangle_count_dense(np.array([0]), np.array([1]), 2) == 0
     tri = tri_ops.triangle_count(np.array([0, 1, 2]), np.array([1, 2, 0]), 3)
     assert tri == 1
+
+
+def test_numpy_baseline_port_matches_python_port():
+    """bench.py's PRIMARY CPU baseline (numpy-vectorized faithful port)
+    must compute exactly what the interpreted reference port computes —
+    the vectorization may change the cost model, never the counts."""
+    import bench
+
+    rng = np.random.default_rng(11)
+    for _ in range(8):
+        e = int(rng.integers(1, 3000))
+        v = int(rng.integers(4, 400))
+        src = rng.integers(0, v, e)
+        dst = (src + 1 + rng.integers(0, v - 1, e)) % v
+        assert (bench.cpu_reference_window_counts_numpy(src, dst, 512)
+                == bench.cpu_reference_window_counts(src, dst, 512))

@@ -1,12 +1,15 @@
 #!/bin/bash
 # TPU work queue: poll the tunnel; when it answers, run the round's
 # evidence suite sequentially (bench -> kernel profile -> scale run).
-# Each stage logs to /tmp/tpuq_*.log; the queue stops polling after
-# MAX_WAIT_S without a live backend.
+# Each stage tees raw stdout/stderr to logs/ (committed — chip evidence
+# must never exist only as a transcription); the queue stops polling
+# after MAX_WAIT_S without a live backend.
 set -u
 MAX_WAIT_S=${MAX_WAIT_S:-14400}
 POLL_S=${POLL_S:-180}
+RTAG=${RTAG:-r03}
 cd /root/repo
+mkdir -p logs
 
 waited=0
 while true; do
@@ -22,11 +25,11 @@ while true; do
 done
 
 echo "=== stage 1: bench.py (first number in hand, untuned K) ==="
-timeout 5400 python bench.py >/tmp/tpuq_bench.log 2>/tmp/tpuq_bench.err
-echo "bench rc=$? ; $(tail -1 /tmp/tpuq_bench.log 2>/dev/null)"
+timeout 5400 python bench.py >"logs/bench_${RTAG}_stage1.log" 2>"logs/bench_${RTAG}_stage1.err"
+echo "bench rc=$? ; $(tail -1 "logs/bench_${RTAG}_stage1.log" 2>/dev/null)"
 
 echo "=== stage 2: profile_kernels (writes the chip k-sweep) ==="
-timeout 5400 python tools/profile_kernels.py >/tmp/tpuq_prof.log 2>/tmp/tpuq_prof.err
+timeout 5400 python tools/profile_kernels.py >"logs/profile_${RTAG}.log" 2>"logs/profile_${RTAG}.err"
 prof_rc=$?
 echo "profile rc=$prof_rc"
 
@@ -35,13 +38,13 @@ echo "profile rc=$prof_rc"
 # and still exits 0)
 if [ "$prof_rc" -eq 0 ] && grep -q '"backend": "tpu"' PERF.json 2>/dev/null; then
   echo "=== stage 3: bench.py again (now reads the chip-tuned K from PERF.json) ==="
-  timeout 5400 python bench.py >/tmp/tpuq_bench2.log 2>/tmp/tpuq_bench2.err
-  echo "bench2 rc=$? ; $(tail -1 /tmp/tpuq_bench2.log 2>/dev/null)"
+  timeout 5400 python bench.py >"logs/bench_${RTAG}_stage3.log" 2>"logs/bench_${RTAG}_stage3.err"
+  echo "bench2 rc=$? ; $(tail -1 "logs/bench_${RTAG}_stage3.log" 2>/dev/null)"
 else
   echo "stage 3 skipped: no chip-labeled k-sweep to consume (profile rc=$prof_rc)"
 fi
 
 echo "=== stage 4: scale_run (driver+fused on chip, sharded on cpu mesh) ==="
-timeout 7200 python tools/scale_run.py >/tmp/tpuq_scale.log 2>/tmp/tpuq_scale.err
+timeout 7200 python tools/scale_run.py >"logs/scale_${RTAG}.log" 2>"logs/scale_${RTAG}.err"
 echo "scale rc=$?"
 echo "queue done"
