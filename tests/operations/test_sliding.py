@@ -227,3 +227,69 @@ def test_sliding_window_all_sum(env):
                                Time.milliseconds_of(100)).sum(0)
     # windows: [0,200)=250, [100,300)=500, [200,400)=600, [300,500)=350
     assert run_and_sort(env, out) == sorted(["250", "500", "600", "350"])
+
+
+def test_sliding_associative_reduce_takes_pane_path(env):
+    """fn + associative=True gets the pane path too (not just named
+    monoids): golden values on the 4-edge fixture."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops.neighborhood import make_reduce_kernel
+
+    udf = JaxEdgesReduce(fn=lambda a, b: jnp.maximum(a, b),
+                         associative=True)
+    assert hasattr(make_reduce_kernel(udf), "pane_kernel")
+
+    out = _graph(env).slice(
+        Time.milliseconds_of(200), EdgeDirection.OUT,
+        slide=Time.milliseconds_of(100),
+    ).reduce_on_edges(udf)
+    assert run_and_sort(env, out) == SLIDING_MAX
+
+
+@pytest.mark.parametrize("direction", [EdgeDirection.OUT,
+                                       EdgeDirection.IN,
+                                       EdgeDirection.ALL])
+def test_sliding_random_parity_host_vs_assoc_pane(env, direction):
+    """Random ragged stream with gaps: the associative-fn pane path ==
+    host reference semantics, all directions (the analog of
+    test_sliding_random_parity_host_vs_pane for the fn tier; gcd is
+    associative+commutative but NOT a named monoid)."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(13)
+    edges = []
+    t = 0
+    for _ in range(200):
+        t += int(rng.integers(1, 120))
+        edges.append(Edge(int(rng.integers(0, 12)),
+                          int(rng.integers(0, 12)), t))
+    size, slide = Time.milliseconds_of(400), Time.milliseconds_of(100)
+
+    import math
+
+    host = _graph(env, edges).slice(size, direction, slide=slide) \
+        .reduce_on_edges(EdgesReduce(lambda a, b: math.gcd(a, b)))
+    want = run_and_sort(env, host)
+
+    env2 = type(env)(clock=env.clock)
+    dev = _graph(env2, edges).slice(size, direction, slide=slide) \
+        .reduce_on_edges(JaxEdgesReduce(fn=jnp.gcd, associative=True))
+    assert run_and_sort(env2, dev) == want
+    assert len(want) > 0
+
+
+def test_sliding_assoc_pane_fallback_matches(env, monkeypatch):
+    """Over the pane-cell limit the associative pane kernel falls back
+    to per-window device calls — same results."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops import neighborhood
+
+    monkeypatch.setattr(neighborhood, "_PANE_CELL_LIMIT", 1)
+    out = _graph(env).slice(
+        Time.milliseconds_of(200), EdgeDirection.OUT,
+        slide=Time.milliseconds_of(100),
+    ).reduce_on_edges(JaxEdgesReduce(fn=lambda a, b: jnp.maximum(a, b),
+                                     associative=True))
+    assert run_and_sort(env, out) == SLIDING_MAX
