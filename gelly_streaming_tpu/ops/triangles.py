@@ -102,8 +102,17 @@ def intersect_local(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
     compare axis counts it exactly once.
     """
     sentinel = nbr.shape[0] - 1
-    rows_a = nbr[ea]                             # [Ep, K]
-    rows_b = nbr[eb]                             # [Ep, K]
+    return intersect_rows(nbr[ea], nbr[eb], emask, sentinel)
+
+
+def intersect_rows(rows_a: jax.Array, rows_b: jax.Array,
+                   emask: jax.Array, sentinel: int) -> jax.Array:
+    """The chunked broadcast equality compare on pre-gathered row
+    pairs: rows_a/rows_b are [Ep, K] neighbor rows aligned per edge
+    (fill = sentinel). Factored out of intersect_local so the
+    owner-local sharded path (which materializes each edge's row pair
+    via collectives instead of a replicated table lookup) shares the
+    comparator."""
     valid = (rows_a < sentinel) & emask[:, None]
     k = rows_a.shape[1]
     if k == 0:

@@ -378,6 +378,38 @@ for name, eng in (
                  "backend": "cpu-virtual-mesh",
                  "per_window_ms": round(t / num_w * 1e3, 3),
                  "edges_per_s": round(num_w * eb / t)}
+
+# owner vs replicated neighbor-row distribution (drives
+# resolve_table_mode): wall-clock at a small-table shape AND the
+# 10M-scale bucket shape (the VERDICT-flagged risk case), plus the
+# analytic ICI accounting. The top-level *_edges_per_s keys carry the
+# LARGE config — the decisive row for the selection.
+from gelly_streaming_tpu.parallel.sharded import (ici_time_model,
+                                                  window_collective_bytes)
+
+tbl = {"devices": 8, "backend": "cpu-virtual-mesh", "rows": []}
+for ceb, cvb, cw in ((8192, 16384, 16), (65536, 262144, 2)):
+    csrc, cdst = make_stream(cw * ceb, cvb)
+    row = {"edge_bucket": ceb, "vertex_bucket": cvb, "windows": cw}
+    counts = {}
+    for mode in ("replicated", "owner"):
+        k = ShardedTriangleWindowKernel(mesh, edge_bucket=ceb,
+                                        vertex_bucket=cvb, table=mode)
+        counts[mode] = k.count_stream(csrc, cdst)   # compile + warm
+        t0 = time.perf_counter(); k.count_stream(csrc, cdst)
+        t = time.perf_counter() - t0
+        row[mode + "_edges_per_s"] = round(cw * ceb / t)
+        b = window_collective_bytes(8, k.vb, k.kb, k.cap, mode)
+        row[mode + "_ici_bytes_per_window"] = round(b["total"])
+        b5 = ici_time_model(b)
+        row[mode + "_ici_ms_v5e_model"] = round(b5["total"] * 1e3, 3)
+    row["counts_match"] = counts["replicated"] == counts["owner"]
+    tbl["rows"].append(row)
+big = tbl["rows"][-1]
+tbl["owner_edges_per_s"] = big["owner_edges_per_s"]
+tbl["replicated_edges_per_s"] = big["replicated_edges_per_s"]
+tbl["counts_match"] = all(r["counts_match"] for r in tbl["rows"])
+out["sharded_table"] = tbl
 print(json.dumps(out))
 """ % REPO
     # PYTHONPATH is stripped so the baked sitecustomize can't dial the
@@ -533,6 +565,11 @@ def main():
         results["sharded"] = section_sharded(REPO)
         if "error" not in results["sharded"]:
             ok_sections.append("sharded")
+            # hoist the table-mode comparison to the top level, where
+            # parallel/sharded.resolve_table_mode reads it
+            if "sharded_table" in results["sharded"]:
+                results["sharded_table"] = results["sharded"].pop(
+                    "sharded_table")
         print(json.dumps({"sharded": results["sharded"]}), flush=True)
         flush()
     print("wrote %s" % wrote[0], file=sys.stderr)
