@@ -214,6 +214,7 @@ def window_collective_bytes(n: int, vb: int, kb: int, cap: int,
     derives from it). Models: ring all-reduce (psum/pmax) moves
     2·(n-1)/n × payload per chip; all_gather and all_to_all move
     (n-1)/n × the full gathered/exchanged buffer."""
+    assert table in ("replicated", "owner"), table
     i32 = 4
     f = (n - 1) / n if n > 1 else 0.0
     m = n * cap   # owned-edge slots per shard after the exchange

@@ -245,7 +245,49 @@ print(json.dumps({
     return got
 
 
-LEGS = {"driver": run_driver, "fused": run_fused, "sharded": run_sharded}
+def run_citation(_path: str) -> dict:
+    """Real-shaped leg (VERDICT r2 missing-3): the cit-HepPh-calibrated
+    citation stream (utils/realgraph.py — exact published node/edge
+    counts, clustering/triangles within a few percent of the SNAP
+    figures, power-law degree tail, DAG timestamps) through the
+    driver's batched path. The synthetic legs above characterize
+    scale; this one pins throughput on real-graph shape, where hub
+    rows and co-citation clustering stress the K-bucket ladder."""
+    import jax
+
+    from gelly_streaming_tpu import StreamingAnalyticsDriver
+    from gelly_streaming_tpu.utils.realgraph import citation_stream
+
+    src, dst, _ts = citation_stream()
+    vb = int(max(src.max(), dst.max())) + 1
+    eb = 8_192
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=eb,
+                                   vertex_bucket=vb)
+    # warm with the REAL stream, not zeros: the citation graph's hub
+    # windows overflow the tuned starting K, so the escalation-rung
+    # programs (and the exact-recount path) are part of what the timed
+    # run executes — a zero-stream warm-up would leave them to compile
+    # inside the timing
+    drv.run_arrays(src, dst)
+    drv.reset()
+    t0 = time.perf_counter()
+    res = drv.run_arrays(src, dst)
+    elapsed = time.perf_counter() - t0
+    return {
+        "leg": "citation-driver",
+        "backend": jax.default_backend(),
+        "graph": "cit-HepPh-calibrated (gelly_streaming_tpu/utils/"
+                 "realgraph.py; SNAP-published anchors)",
+        "edges": len(src),
+        "vertices": vb,
+        "windows": len(res),
+        "edges_per_sec": round(len(src) / elapsed),
+        "window_triangles_last": res[-1].triangles,
+    }
+
+
+LEGS = {"driver": run_driver, "fused": run_fused, "sharded": run_sharded,
+        "citation": run_citation}
 
 
 def run_leg_subprocess(leg: str, fixture: str, timeout_s: int,
@@ -275,7 +317,7 @@ def main():
     ap.add_argument("--out", default="/tmp/gs_scale_fixture.txt")
     ap.add_argument("--leg", help="child mode: run ONE leg in-process")
     ap.add_argument("legs", nargs="*",
-                    default=["driver", "fused", "sharded"])
+                    default=["driver", "fused", "sharded", "citation"])
     args = ap.parse_args()
 
     if not os.path.exists(args.out):
