@@ -94,7 +94,12 @@ def test_dense_ignores_error_stub_sections(selection_env):
     assert triangles._resolve_dense_choice() == ("xla", DENSE_LIMIT)
 
 
-def test_tuned_kb_reads_matching_backend_sweep(selection_env):
+def test_tuned_kb_picks_fastest_measured_row(selection_env):
+    """The fastest measured row wins OUTRIGHT — per_window_ms was
+    measured on a run that already paid that K's overflow recounts, so
+    an occasionally-overflowing K that wins net is taken (the CPU
+    sweep's eb=32768 K=32 case), while a K whose recounts make it slow
+    loses on its own measurement."""
     selection_env("cpu", "cpu", window=[{
         "edge_bucket": 8192,
         "k_sweep": [
@@ -102,11 +107,25 @@ def test_tuned_kb_reads_matching_backend_sweep(selection_env):
              "overflow_recounts_per_run": 0},
             {"k_bucket": 64, "per_window_ms": 5.0,
              "overflow_recounts_per_run": 0},
-            # fastest row, but it overflowed: excluded
+            # fastest row WITH its recount cost priced in: wins
             {"k_bucket": 16, "per_window_ms": 1.0,
              "overflow_recounts_per_run": 2},
         ]}])
-    assert triangles._tuned_kb(8192) == 32
+    assert triangles._tuned_kb(8192) == 16
+
+
+def test_tuned_kb_recount_heavy_row_loses_on_its_own_measurement(
+        selection_env):
+    selection_env("cpu", "cpu", window=[{
+        "edge_bucket": 8192,
+        "k_sweep": [
+            # every window recounted: the measurement itself is slow
+            {"k_bucket": 16, "per_window_ms": 50.0,
+             "overflow_recounts_per_run": 64},
+            {"k_bucket": 64, "per_window_ms": 5.0,
+             "overflow_recounts_per_run": 0},
+        ]}])
+    assert triangles._tuned_kb(8192) == 64
 
 
 def test_tuned_kb_falls_back_to_analytic_on_backend_mismatch(

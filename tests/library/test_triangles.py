@@ -324,8 +324,9 @@ def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
                                      "pallas_vs_xla_compare": 0.8}}, f)
         tri_ops._INTERSECT_CHOICE = None
         assert tri_ops.resolve_intersect_impl() is tri_ops.intersect_local
-        # tuned K: fastest zero-overflow sweep entry wins; rows with
-        # recounts or other edge buckets are ignored
+        # tuned K: the fastest MEASURED sweep entry wins outright (its
+        # per_window_ms already includes that K's recount cost); rows
+        # for other edge buckets are ignored
         with open(perf_path, "w") as f:
             json.dump({"backend": "tpu", "window": [
                 {"edge_bucket": 4096, "k_sweep": [
@@ -336,7 +337,7 @@ def test_dense_choice_is_measurement_driven(tmp_path, monkeypatch):
                     {"k_bucket": 16, "per_window_ms": 1.0,
                      "overflow_recounts_per_run": 3}]}]}, f)
         tri_ops._TUNED_KB.clear()
-        assert tri_ops._tuned_kb(4096) == 32
+        assert tri_ops._tuned_kb(4096) == 16
         assert tri_ops._tuned_kb(8192) == min(
             128, 2 * int(np.sqrt(8192)))  # unmeasured bucket: heuristic
 

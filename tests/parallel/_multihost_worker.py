@@ -68,6 +68,29 @@ def main() -> None:
     # out_spec P() → fully replicated: every process reads the result
     got = np.asarray(counts)[:10]
     np.testing.assert_array_equal(got, np.full(10, 2))
+
+    # the FULL window triangle pipeline across the process boundary —
+    # every collective class the framework uses crosses the simulated
+    # DCN here: psum (degrees/count), all_to_all (pair exchange), and
+    # pmax table merge / all_gather+all_to_all row exchange (both
+    # neighbor-row distribution modes)
+    from gelly_streaming_tpu.parallel.sharded import (
+        make_sharded_window_triangle_fn)
+
+    ta = np.resize(np.array([0, 0, 1, 1, 2, 0, 3], np.int32), 16)
+    tb = np.resize(np.array([1, 2, 2, 3, 3, 1, 3], np.int32), 16)
+    tvalid = np.ones(16, bool)
+    for table in ("replicated", "owner"):
+        tri_fn = make_sharded_window_triangle_fn(
+            flat, eb=16, vb=16, kb=8, cap=8, table=table)
+        count, b_ovf, k_ovf = tri_fn(
+            global_array(ta, P("shard")), global_array(tb, P("shard")),
+            global_array(tvalid, P("shard")))
+        count, b_ovf, k_ovf = (int(np.asarray(x))
+                               for x in (count, b_ovf, k_ovf))
+        assert (count, b_ovf, k_ovf) == (2, 0, 0), (table, count,
+                                                    b_ovf, k_ovf)
+
     print(f"MULTIHOST_OK {proc_id}", flush=True)
 
 
