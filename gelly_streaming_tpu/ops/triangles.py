@@ -360,6 +360,10 @@ def build_window_counter(vb: int, kb: int):
             jnp.where(ok, b, sent).astype(jnp.int32))
 
         # ---- neighbor-row intersection at each oriented edge
+        # (an optimization_barrier before the intersect wins ~20% on a
+        # single-window CPU microbenchmark at K=32 but measures FLAT
+        # through the lax.map streaming form the bench actually runs —
+        # tried and reverted in round 3; re-evaluate on chip)
         emask = a < sent
         count = intersect(nbr, a.astype(jnp.int32),
                           b.astype(jnp.int32), emask)
@@ -392,21 +396,52 @@ def _tuned_kb(eb: int) -> int:
     heuristic."""
     if eb in _TUNED_KB:
         return _TUNED_KB[eb]
-    kb = min(128, 2 * int(np.sqrt(eb)))
     # K tuning applies per BACKEND: the committed k-sweep for whatever
     # backend this process runs.
+    _TUNED_KB[eb] = _fastest_sweep_row(
+        eb, "k_sweep", "k_bucket", default=min(128, 2 * int(np.sqrt(eb))))
+    return _TUNED_KB[eb]
+
+
+def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
+                       default: int) -> int:
+    """Shared selection core of _tuned_kb/_tuned_chunk: the fastest
+    measured row (min per_window_ms, recount cost included in the
+    measurement) of this bucket's backend-matched committed sweep;
+    `default` when unmeasured."""
     perf = _load_matching_perf()
     if perf is not None:
         for row in perf.get("window", []):
             if row.get("edge_bucket") != eb:
                 continue
-            measured = [s for s in row.get("k_sweep", [])
+            measured = [s for s in row.get(sweep_key, [])
                         if s.get("per_window_ms")]
             if measured:
-                kb = min(measured, key=lambda s: s["per_window_ms"])[
-                    "k_bucket"]
-    _TUNED_KB[eb] = kb
-    return kb
+                default = min(measured,
+                              key=lambda s: s["per_window_ms"])[value_key]
+    return default
+
+_TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch
+
+
+def _tuned_chunk(eb: int) -> int:
+    """Windows per count_stream dispatch: the fastest measured
+    chunk_sweep row for this bucket on this backend (committed
+    PERF.json `window` rows; the sweep runs at the same fastest-row K
+    that _tuned_kb selects, so the chunk is tuned for the K production
+    actually runs). Fallback: the class default. On CPU the committed
+    sweep is flat within a few percent at every bucket — dispatch is
+    ~free off-chip, so the pick there is load-noise-driven and
+    harmless; the selector exists for the tunneled chip, where each
+    dispatch costs ~0.2s and the chunk size sets how that latency
+    amortizes."""
+    if eb in _TUNED_CHUNK:
+        return _TUNED_CHUNK[eb]
+    _TUNED_CHUNK[eb] = _fastest_sweep_row(
+        eb, "chunk_sweep", "windows_per_dispatch",
+        default=TriangleWindowKernel.MAX_STREAM_WINDOWS)
+    return _TUNED_CHUNK[eb]
+
 
 class TriangleWindowKernel:
     """One compiled program for an unbounded stream of windows.
@@ -450,6 +485,9 @@ class TriangleWindowKernel:
         self.kb = seg_ops.bucket_size(
             k_bucket if k_bucket else _tuned_kb(self.eb))
         self.kb_max = seg_ops.bucket_size(2 * int(np.sqrt(self.eb)))
+        # instance attribute shadows the class default when a committed
+        # chunk sweep exists for this bucket on this backend
+        self.MAX_STREAM_WINDOWS = _tuned_chunk(self.eb)
         self._fns = {self.kb: self._build(self.kb)}
         self._stream_fns = {}
 

@@ -32,6 +32,7 @@ def selection_env(tmp_path, monkeypatch):
     monkeypatch.setattr(triangles, "_INTERSECT_JIT", None)
     monkeypatch.setattr(triangles, "_DENSE_CHOICE", None)
     monkeypatch.setattr(triangles, "_TUNED_KB", {})
+    monkeypatch.setattr(triangles, "_TUNED_CHUNK", {})
 
     def configure(file_backend, process_backend, **sections):
         perf_path.write_text(
@@ -135,3 +136,26 @@ def test_tuned_kb_falls_back_to_analytic_on_backend_mismatch(
         "k_sweep": [{"k_bucket": 32, "per_window_ms": 3.0,
                      "overflow_recounts_per_run": 0}]}])
     assert triangles._tuned_kb(8192) == min(128, 2 * int(8192 ** 0.5))
+
+
+def test_tuned_chunk_reads_matching_backend_sweep(selection_env):
+    selection_env("cpu", "cpu", window=[{
+        "edge_bucket": 8192,
+        "chunk_sweep": [
+            {"windows_per_dispatch": 32, "per_window_ms": 9.0},
+            {"windows_per_dispatch": 128, "per_window_ms": 7.5},
+            {"windows_per_dispatch": 64, "per_window_ms": 8.0},
+        ]}])
+    assert triangles._tuned_chunk(8192) == 128
+    # unmeasured bucket: class default
+    assert (triangles._tuned_chunk(4096)
+            == triangles.TriangleWindowKernel.MAX_STREAM_WINDOWS)
+
+
+def test_tuned_chunk_backend_mismatch_keeps_default(selection_env):
+    selection_env("tpu", "cpu", window=[{
+        "edge_bucket": 8192,
+        "chunk_sweep": [{"windows_per_dispatch": 128,
+                         "per_window_ms": 1.0}]}])
+    assert (triangles._tuned_chunk(8192)
+            == triangles.TriangleWindowKernel.MAX_STREAM_WINDOWS)

@@ -167,6 +167,11 @@ def section_window(results: dict) -> None:
         for kb in sorted({default_kb, default_kb // 2, default_kb // 4}):
             kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
                                         k_bucket=kb)
+            # anchor the chunk size too (same ratchet guard as K): a
+            # committed chunk pick must not set the conditions the
+            # k-sweep is measured under, or successive profiling runs
+            # stop being comparable
+            kern.MAX_STREAM_WINDOWS = TriangleWindowKernel.MAX_STREAM_WINDOWS
             kernels[kern.kb] = kern
             # one instrumented pass counts the overflow recounts an
             # undersized K pays (and warms every program it needs),
@@ -216,7 +221,9 @@ def section_window(results: dict) -> None:
                 "per_window_ms": round(t / cnum_w * 1e3, 3),
                 "edges_per_s": round(cnum_w * eb / t),
             })
-        del kern.MAX_STREAM_WINDOWS   # restore the class default
+        # leave the kernel at the anchor chunk (the instance attr is
+        # always set now — __init__ tunes it, this sweep overwrote it)
+        kern.MAX_STREAM_WINDOWS = TriangleWindowKernel.MAX_STREAM_WINDOWS
         out.append(row)
     results["window"] = out
 
