@@ -203,9 +203,15 @@ class StreamingAnalyticsDriver:
                 self._sh_tri = ShardedTriangleWindowKernel(
                     self.mesh, edge_bucket=self.eb,
                     vertex_bucket=self.vb)
+                # every TRIANGLE stream-chunk program compiles at
+                # (re)build time, never mid-stream; the final-flush
+                # analytics programs still first-compile at the flush —
+                # the one violation window scale_run's assert tolerates
+                self._sh_tri.warm_chunks()
         elif "triangles" in self.analytics:
             self._tri_kernel = tri_ops.TriangleWindowKernel(
                 edge_bucket=self.eb, vertex_bucket=self.vb)
+            self._tri_kernel.warm_chunks()
 
     # ------------------------------------------------------------------
     def run_file(self, path: str) -> List[WindowResult]:

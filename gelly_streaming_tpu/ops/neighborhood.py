@@ -245,6 +245,20 @@ def window_stack_combine(cells, counts, wp: int, name: str):
         pv, pc, wp, lambda av, ac, nv, nc: (comb(av, nv), ac + nc))
 
 
+def masked_combine(fn, av, ap, nv, npn):
+    """One presence-masked combine step for a general associative fn
+    (which has no identity element to pad with): fn(a, next) where
+    both cells are present, the present side where only one is, `a`
+    unchanged otherwise. The single home of this selection logic — the
+    sliding window combine below and the sharded cross-shard fold
+    (parallel/sharded.py assoc tier) must stay semantically
+    identical."""
+    import jax.numpy as jnp
+
+    return (jnp.where(ap & npn, fn(av, nv), jnp.where(npn, nv, av)),
+            ap | npn)
+
+
 @functools.lru_cache(maxsize=256)
 def _jit_assoc_combine(fn, wp: int):
     """Jitted masked window combine for a generic associative fn: no
@@ -263,13 +277,11 @@ def _jit_assoc_combine(fn, wp: int):
         pv = jnp.concatenate([pad_v, cells, pad_v])
         pp = jnp.concatenate([pad_p, present, pad_p])
 
-        def step(av, ap, nv, npn):
-            # fn runs elementwise on every cell (garbage in absent
-            # slots); the where tree keeps only the licensed results
-            return (jnp.where(ap & npn, fn(av, nv),
-                              jnp.where(npn, nv, av)), ap | npn)
-
-        return _combine_shifted(pv, pp, wp, step)
+        # fn runs elementwise on every cell (garbage in absent slots);
+        # masked_combine keeps only the licensed results
+        return _combine_shifted(
+            pv, pp, wp,
+            lambda av, ap, nv, npn: masked_combine(fn, av, ap, nv, npn))
 
     return run
 

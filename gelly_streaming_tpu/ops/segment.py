@@ -211,6 +211,25 @@ def segmented_reduce(reduce_fn: Callable, segment_ids: np.ndarray,
     return res, has_any
 
 
+def warm_stream_buckets(kernel) -> None:
+    """Compile every stream-chunk program a window kernel's _run_stack
+    can dispatch at its current configuration — the full
+    MAX_STREAM_WINDOWS chunk and each power-of-two ragged window
+    bucket — by running count_stream on zero-filled streams of each
+    size (self-loops, dropped as invalid: one cheap dispatch per
+    bucket). Shared by TriangleWindowKernel.warm_chunks and
+    ShardedTriangleWindowKernel.warm_chunks so both kernels always
+    warm the same program set."""
+    sizes = {kernel.MAX_STREAM_WINDOWS}
+    w = bucket_size(1)
+    while w < kernel.MAX_STREAM_WINDOWS:
+        sizes.add(w)
+        w *= 2
+    for w in sorted(sizes):
+        z = np.zeros(w * kernel.eb, np.int32)
+        kernel.count_stream(z, z)
+
+
 def window_stack(src: np.ndarray, dst: np.ndarray, eb: int,
                  sentinel: int):
     """Pad a COO stream to whole `eb`-sized windows and reshape to

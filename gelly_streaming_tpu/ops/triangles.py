@@ -565,6 +565,14 @@ class TriangleWindowKernel:
             counts.extend(int(x) for x in c)
         return counts
 
+    def warm_chunks(self) -> None:
+        """Compile every stream-chunk program _run_stack can dispatch
+        at the current K, so a streaming consumer (the driver) pays
+        stream-program compiles at (re)build time, never mid-stream:
+        the steady-state compile discipline tools/scale_run.py
+        asserts. (seg_ops.warm_stream_buckets is the shared body.)"""
+        seg_ops.warm_stream_buckets(self)
+
     def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
         """Exact counts of every tumbling `edge_bucket`-sized window of
         the stream, batched into one device program per

@@ -124,31 +124,78 @@ def make_sharded_triangle_fn(mesh):
 # ----------------------------------------------------------------------
 
 def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
-                             panes_per_window: int, name: str):
-    """Sliding-window monoid reduce at multi-chip scale — the sharded
-    form of the single-chip pane path (ops/neighborhood.py
-    _make_pane_reduce; see docs/DESIGN.md §1.1): edges sharded across
-    chips (P1), each shard segment-reduces its slice over flattened
-    (pane, vertex) cell ids into a full [pane_bucket, V+1] partial, ONE
-    collective (psum / pmin / pmax, P2) merges the partials, and every
-    window is a static stack of panes_per_window shifted pane slices
-    combined elementwise — all windows from one program, no edge
-    duplication.
+                             panes_per_window: int, name: str = None,
+                             fn=None):
+    """Sliding-window reduce at multi-chip scale — the sharded form of
+    the single-chip pane path (ops/neighborhood.py _make_pane_reduce;
+    see docs/DESIGN.md §1.1): edges sharded across chips (P1), each
+    shard reduces its slice over flattened (pane, vertex) cell ids
+    into a full [pane_bucket, V+1] partial, the partials merge across
+    the mesh (P2), and every window is a static stack of
+    panes_per_window shifted pane slices combined elementwise — all
+    windows from one program, no edge duplication.
+
+    Two tiers, mirroring the single-chip pane path:
+    - `name` ('sum'|'min'|'max'): segment kernels per shard, ONE
+      psum/pmin/pmax collective merge, identity-padded window combine.
+    - `fn` + nothing else (a user fn DECLARED associative): flagged
+      associative scan per shard over cell-sorted values, an
+      all_gather of the [pb, V+1] cell partials + a left-fold over the
+      shard axis with a presence mask (no identity element exists for
+      a general fn, and no psum-style collective applies a custom
+      combine), then the masked window combine. Shard index order =
+      edge-position order (the edge axis splits contiguously), so the
+      cross-shard fold preserves arrival order up to the reordering
+      associativity licenses.
 
     Returns jitted fn(src, pane, val, valid) -> (win_vals, win_counts),
     both [pane_bucket + panes_per_window - 1, vertex_bucket + 1]; a
-    (window, vertex) cell is meaningful iff win_counts[w, v] > 0
-    (min/max cells left at their identity otherwise). Window w covers
-    dense panes [w - panes_per_window + 1, w]; src/pane/val/valid are
-    edge-sharded arrays (pad with valid=False).
+    (window, vertex) cell is meaningful iff win_counts[w, v] > 0. In
+    `name` mode win_counts are edge counts (min/max cells left at
+    their identity otherwise); in `fn` mode they are 0/1 presence
+    flags. Window w covers dense panes [w - panes_per_window + 1, w];
+    src/pane/val/valid are edge-sharded arrays (pad with valid=False).
     """
-    assert name in ("sum", "min", "max"), name
+    assert (name is None) != (fn is None)
+    assert name in (None, "sum", "min", "max"), name
     vbp = vertex_bucket + 1
     pb = pane_bucket
     wp = panes_per_window
     n_cells = pb * vbp
-    coll = {"sum": jax.lax.psum, "min": jax.lax.pmin,
-            "max": jax.lax.pmax}[name]
+    n = shard_count(mesh)
+
+    if name is not None:
+        coll = {"sum": jax.lax.psum, "min": jax.lax.pmin,
+                "max": jax.lax.pmax}[name]
+
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                      P(SHARD_AXIS)),
+            out_specs=(P(), P()),
+        )
+        def partials(src, pane, val, valid):
+            ids = jnp.where(valid, pane * vbp + src, n_cells)
+            # segment_min/max fill empty cells with dtype extremes
+            # (+/-inf for floats — NOT _pane_identity); per-shard fills
+            # absorb in pmin/pmax, and window_stack_combine
+            # re-normalizes globally empty (count==0) cells to the
+            # documented identity
+            cells = seg_ops.segment_reduce(val, ids, n_cells + 1,
+                                           name)[:-1].reshape(pb, vbp)
+            counts = jax.ops.segment_sum(
+                jnp.where(valid, 1, 0), ids,
+                n_cells + 1)[:-1].reshape(pb, vbp)
+            return coll(cells, SHARD_AXIS), jax.lax.psum(counts,
+                                                         SHARD_AXIS)
+
+        def run(src, pane, val, valid):
+            from ..ops.neighborhood import window_stack_combine
+
+            cells, counts = partials(src, pane, val, valid)
+            return window_stack_combine(cells, counts, wp, name)
+
+        return jax.jit(run)
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -156,23 +203,69 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
                   P(SHARD_AXIS)),
         out_specs=(P(), P()),
     )
-    def partials(src, pane, val, valid):
+    def assoc_partials(src, pane, val, valid):
         ids = jnp.where(valid, pane * vbp + src, n_cells)
-        # segment_min/max fill empty cells with dtype extremes (+/-inf
-        # for floats — NOT _pane_identity); per-shard fills absorb in
-        # pmin/pmax, and window_stack_combine re-normalizes globally
-        # empty (count==0) cells to the documented identity
-        cells = seg_ops.segment_reduce(val, ids, n_cells + 1,
-                                       name)[:-1].reshape(pb, vbp)
-        counts = jax.ops.segment_sum(
-            jnp.where(valid, 1, 0), ids, n_cells + 1)[:-1].reshape(pb, vbp)
-        return coll(cells, SHARD_AXIS), jax.lax.psum(counts, SHARD_AXIS)
+        order = jnp.argsort(ids, stable=True)
+        ids_s = ids[order]
+        vals_s = val[order]
+        flags = jnp.concatenate(
+            [jnp.ones(1, bool), ids_s[1:] != ids_s[:-1]])
+
+        def comb(x, y):
+            fx, vx = x
+            fy, vy = y
+            # flagged associative scan: a cell-start flag resets the
+            # running combine (same kernel shape as
+            # seg_ops._jit_assoc_reduce, inlined here because this
+            # body must trace inside shard_map)
+            return fx | fy, jnp.where(fy, vy, fn(vx, vy))
+
+        _, scanned = jax.lax.associative_scan(comb, (flags, vals_s))
+        idx = jnp.arange(ids_s.shape[0])
+        last = jax.ops.segment_max(
+            jnp.where(ids_s < n_cells, idx, -1), ids_s,
+            n_cells + 1)[:-1]
+        present = (last >= 0).reshape(pb, vbp)
+        cells = scanned[jnp.maximum(last, 0)].reshape(pb, vbp)
+
+        # cross-shard merge: gather every shard's partials and fold in
+        # shard order with a presence mask — no collective applies a
+        # custom fn, and a general fn has no identity to pad with
+        from ..ops.neighborhood import masked_combine
+
+        allc = jax.lax.all_gather(cells, SHARD_AXIS)     # [n, pb, vbp]
+        allp = jax.lax.all_gather(present, SHARD_AXIS)
+        # balanced tree over the shard axis (O(log n) depth — exactly
+        # what associativity licenses); adjacent pairs combine left-to-
+        # right, so shard order (= edge-position order) is preserved
+        vals = [allc[i] for i in range(n)]
+        pres = [allp[i] for i in range(n)]
+        while len(vals) > 1:
+            nxt_v, nxt_p = [], []
+            for i in range(0, len(vals) - 1, 2):
+                v, p2 = masked_combine(fn, vals[i], pres[i],
+                                       vals[i + 1], pres[i + 1])
+                nxt_v.append(v)
+                nxt_p.append(p2)
+            if len(vals) % 2:
+                nxt_v.append(vals[-1])
+                nxt_p.append(pres[-1])
+            vals, pres = nxt_v, nxt_p
+        accv, accp = vals[0], pres[0]
+        # every shard folded the same gathered partials, so accv/accp
+        # are value-identical everywhere; the no-op pmax makes that
+        # replication explicit for shard_map's vma check (the [pb, vbp]
+        # payload is tiny next to the all_gather above)
+        accv = jax.lax.pmax(accv, SHARD_AXIS)
+        accp = jax.lax.pmax(accp.astype(jnp.int32), SHARD_AXIS) > 0
+        return accv, accp
 
     def run(src, pane, val, valid):
-        from ..ops.neighborhood import window_stack_combine
+        from ..ops.neighborhood import _jit_assoc_combine
 
-        cells, counts = partials(src, pane, val, valid)
-        return window_stack_combine(cells, counts, wp, name)
+        cells, present = assoc_partials(src, pane, val, valid)
+        accv, accp = _jit_assoc_combine(fn, wp)(cells, present)
+        return accv, accp.astype(jnp.int32)
 
     return jax.jit(run)
 
@@ -493,6 +586,13 @@ class ShardedTriangleWindowKernel:
 
     MAX_STREAM_WINDOWS = 64
 
+    def warm_chunks(self) -> None:
+        """Compile every stream-chunk program _run_stack can dispatch
+        at the current (K, cap) — same contract and shared body
+        (seg_ops.warm_stream_buckets) as
+        TriangleWindowKernel.warm_chunks."""
+        seg_ops.warm_stream_buckets(self)
+
     def _stream_fn(self, kb, cap):
         key = ("stream", kb, cap)
         if key not in self._fns:
@@ -696,23 +796,31 @@ class ShardedWindowEngine:
                                jnp.asarray(eb), jnp.asarray(emask)))
 
     def sliding_reduce(self, src, pane, val, num_panes: int,
-                       panes_per_window: int, name: str = "sum"):
-        """Sliding-window monoid reduce over the mesh (the engine form
-        of make_sharded_pane_reduce; docs/DESIGN.md §1.1): `pane` gives
+                       panes_per_window: int, name: str = "sum",
+                       fn=None):
+        """Sliding-window reduce over the mesh (the engine form of
+        make_sharded_pane_reduce; docs/DESIGN.md §1.1): `pane` gives
         each edge's dense slide-index, windows cover panes_per_window
-        consecutive panes. Returns numpy (win_vals, win_counts), both
+        consecutive panes. Pass `name` for a monoid, or `fn` (with
+        name=None) for a user fn declared associative — the same two
+        tiers as the single-chip pane path. Returns numpy
+        (win_vals, win_counts), both
         [pane_bucket + panes_per_window - 1, vb + 1]; a (w, v) cell is
-        meaningful iff win_counts[w, v] > 0, window w covering panes
+        meaningful iff win_counts[w, v] > 0 (edge counts for monoids,
+        0/1 presence for fns), window w covering panes
         [w - panes_per_window + 1, w]. Programs are cached per
-        (pane_bucket, panes_per_window, monoid), so steady-state
+        (pane_bucket, panes_per_window, combine), so steady-state
         streaming pays zero recompilation."""
+        if fn is not None:
+            name = None
         pb = seg_ops.bucket_size(num_panes)
-        key = (pb, panes_per_window, name)
-        fn = self._pane_fns.get(key)
-        if fn is None:
-            fn = make_sharded_pane_reduce(self.mesh, self.vb, pb,
-                                          panes_per_window, name)
-            self._pane_fns[key] = fn
+        key = (pb, panes_per_window, name or fn)
+        pane_fn = self._pane_fns.get(key)
+        if pane_fn is None:
+            pane_fn = make_sharded_pane_reduce(self.mesh, self.vb, pb,
+                                               panes_per_window,
+                                               name=name, fn=fn)
+            self._pane_fns[key] = pane_fn
         src = np.asarray(src, np.int32)
         pane = np.asarray(pane, np.int32)
         val = np.asarray(val)
@@ -725,8 +833,8 @@ class ShardedWindowEngine:
         src, pane, val, valid = self._pad_mesh_arrays(
             target, (src, 0), (pane, 0), (val, 0),
             (np.ones(n, bool), False))
-        wv, wc = fn(jnp.asarray(src), jnp.asarray(pane),
-                    jnp.asarray(val), jnp.asarray(valid))
+        wv, wc = pane_fn(jnp.asarray(src), jnp.asarray(pane),
+                         jnp.asarray(val), jnp.asarray(valid))
         return np.asarray(wv), np.asarray(wc)
 
 

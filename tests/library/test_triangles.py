@@ -402,3 +402,39 @@ def test_numpy_baseline_port_matches_python_port():
         dst = (src + 1 + rng.integers(0, v - 1, e)) % v
         assert (bench.cpu_reference_window_counts_numpy(src, dst, 512)
                 == bench.cpu_reference_window_counts(src, dst, 512))
+
+
+def test_warm_chunks_precompiles_every_stream_bucket():
+    """After warm_chunks, count_stream on any ragged stream length must
+    trigger ZERO new XLA compiles — the steady-state discipline the
+    scale run asserts for the driver (a tuned chunk size must never
+    move first-use compiles into the stream tail)."""
+    import logging
+
+    import jax
+
+    kern = tri_ops.TriangleWindowKernel(edge_bucket=64, vertex_bucket=64)
+    kern.warm_chunks()
+
+    events = []
+
+    class Counter(logging.Handler):
+        def emit(self, record):
+            if "compiling" in record.getMessage().lower():
+                events.append(record.getMessage())
+
+    counter = Counter()
+    jax.config.update("jax_log_compiles", True)
+    logging.getLogger("jax").addHandler(counter)
+    for name in ("jax._src.interpreters.pxla", "jax._src.dispatch"):
+        logging.getLogger(name).setLevel(logging.DEBUG)
+    try:
+        rng = np.random.default_rng(5)
+        for num_w in (1, 3, 7, kern.MAX_STREAM_WINDOWS + 5):
+            e = num_w * kern.eb - 3
+            kern.count_stream(rng.integers(0, 60, e),
+                              rng.integers(0, 60, e))
+    finally:
+        jax.config.update("jax_log_compiles", False)
+        logging.getLogger("jax").removeHandler(counter)
+    assert not events, events
