@@ -212,12 +212,13 @@ def segmented_reduce(reduce_fn: Callable, segment_ids: np.ndarray,
 
 
 def warm_stream_buckets(kernel) -> None:
-    """Compile every stream-chunk program a window kernel's _run_stack
-    can dispatch at its current configuration — the full
+    """AOT-compile every stream-chunk program a window kernel's
+    _run_stack can dispatch at its current configuration — the full
     MAX_STREAM_WINDOWS chunk and each power-of-two ragged window
-    bucket — by running count_stream on zero-filled streams of each
-    size (self-loops, dropped as invalid: one cheap dispatch per
-    bucket). Shared by TriangleWindowKernel.warm_chunks and
+    bucket — via the kernel's own `_stream_exec(wb)` executable cache.
+    Compile-only: nothing executes, so warming costs compile time, not
+    a full-size dispatch per bucket. Shared by
+    TriangleWindowKernel.warm_chunks and
     ShardedTriangleWindowKernel.warm_chunks so both kernels always
     warm the same program set."""
     sizes = {kernel.MAX_STREAM_WINDOWS}
@@ -226,8 +227,7 @@ def warm_stream_buckets(kernel) -> None:
         sizes.add(w)
         w *= 2
     for w in sorted(sizes):
-        z = np.zeros(w * kernel.eb, np.int32)
-        kernel.count_stream(z, z)
+        kernel._stream_exec(w)
 
 
 def window_stack(src: np.ndarray, dst: np.ndarray, eb: int,

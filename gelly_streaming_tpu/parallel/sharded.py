@@ -588,8 +588,8 @@ class ShardedTriangleWindowKernel:
 
     def warm_chunks(self) -> None:
         """Compile every stream-chunk program _run_stack can dispatch
-        at the current (K, cap) — same contract and shared body
-        (seg_ops.warm_stream_buckets) as
+        at the current (K, cap) — same compile-only contract and
+        shared body (seg_ops.warm_stream_buckets) as
         TriangleWindowKernel.warm_chunks."""
         seg_ops.warm_stream_buckets(self)
 
@@ -605,6 +605,29 @@ class ShardedTriangleWindowKernel:
             self._fns[key] = run_stream
         return self._fns[key]
 
+    def _chunk_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, P(None, SHARD_AXIS))
+
+    def _stream_exec(self, wb: int):
+        """AOT-compiled stream program for a [wb, eb] edge-sharded
+        chunk at the current (K, cap) — the kernel's own executable
+        cache, so warm_chunks is compile-only (same design as
+        TriangleWindowKernel._stream_exec)."""
+        key = ("exec", self.kb, self.cap, wb)
+        ex = self._fns.get(key)
+        if ex is None:
+            sharding = self._chunk_sharding()
+            sds_i = jax.ShapeDtypeStruct((wb, self.eb), jnp.int32,
+                                         sharding=sharding)
+            sds_b = jax.ShapeDtypeStruct((wb, self.eb), jnp.bool_,
+                                         sharding=sharding)
+            ex = self._stream_fn(self.kb, self.cap).lower(
+                sds_i, sds_i, sds_b).compile()
+            self._fns[key] = ex
+        return ex
+
     def _run_stack(self, s, d, valid, get_window) -> list:
         """Dispatch a [W, eb] window stack in MAX_STREAM_WINDOWS chunks
         (edge axis sharded over the mesh); `get_window(w)` returns the
@@ -612,10 +635,7 @@ class ShardedTriangleWindowKernel:
         Ragged final chunks pad the window axis to a power-of-two
         bucket so varying stream lengths reuse O(log) compiled
         programs."""
-        from jax.sharding import NamedSharding
-
-        sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
-        fn = self._stream_fn(self.kb, self.cap)
+        sharding = self._chunk_sharding()
         num_w = s.shape[0]
         counts: list = []
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
@@ -626,6 +646,7 @@ class ShardedTriangleWindowKernel:
             args = (jax.device_put(sc, sharding),
                     jax.device_put(dc, sharding),
                     jax.device_put(vc, sharding))
+            fn = self._stream_exec(sc.shape[0])
             # np.array (not asarray): device outputs are read-only views
             c, b_ovf, k_ovf = (np.array(x)[:n] for x in fn(*args))
             for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
