@@ -421,8 +421,83 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     }), flush=True)
 
 
+def run_reduce_leg(metric_suffix: str = "") -> None:
+    """BASELINE.json config #2: `reduceOnEdges` sum-of-weights over
+    tumbling count windows (reference hot loop
+    GraphWindowStream.java:101-121), on the columnar engine
+    (ops/windowed_reduce.py). Baseline: a vectorized faithful numpy
+    port of the per-window fold (np.bincount(weights) groupby-sum —
+    the stiffest single-core form of the reference's per-record
+    accumulate), parity-asserted before timing."""
+    from gelly_streaming_tpu.ops.windowed_reduce import WindowedEdgeReduce
+
+    num_edges, window_edges = 2_097_152, 8_192
+    num_vertices = 1 << 14
+    src, dst = make_stream(num_edges, num_vertices)
+    val = (1 + (src + 3 * dst) % 97).astype(np.int32)
+    reps = int(os.environ.get("GS_BENCH_REPS", "3"))
+
+    def np_port():
+        out = []
+        for lo in range(0, num_edges, window_edges):
+            out.append(np.bincount(
+                src[lo:lo + window_edges], val[lo:lo + window_edges],
+                minlength=num_vertices).astype(np.int64))
+        return out
+
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        base = np_port()
+        ts.append(time.perf_counter() - t0)
+    cpu_rate = num_edges / float(np.median(ts))
+
+    eng = WindowedEdgeReduce(vertex_bucket=num_vertices,
+                             edge_bucket=window_edges, name="sum",
+                             direction="out")
+    got = eng.process_stream(src, dst, val)   # warm + parity material
+    assert len(got) == len(base)
+    for (cells, _cnt), want in zip(got, base):
+        np.testing.assert_array_equal(
+            cells[:num_vertices].astype(np.int64), want)
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        eng.process_stream(src, dst, val)
+        ts.append(time.perf_counter() - t0)
+    rate = num_edges / float(np.median(ts))
+    print(json.dumps({
+        "metric": "edges/sec/chip, windowed reduceOnEdges "
+                  "sum-of-weights (power-law stream, %d-edge "
+                  "windows)%s" % (window_edges, metric_suffix),
+        "value": round(rate),
+        "unit": "edges/s",
+        "vs_baseline": round(rate / cpu_rate, 2),
+        "baseline_cpu_edges_per_s": round(cpu_rate),
+        "num_edges": num_edges,
+    }), flush=True)
+
+
 def main():
     metric_suffix = ""
+    if os.environ.get("GS_BENCH_REDUCE"):
+        # reduce-leg child (same re-exec/watchdog/capacity contract as
+        # the scale children)
+        if "--cpu" in sys.argv or os.environ.get(
+                "GS_BENCH_CPU_FALLBACK") == "1":
+            from gelly_streaming_tpu.core.platform import use_cpu
+            use_cpu()
+        try:
+            run_reduce_leg(os.environ.get("GS_BENCH_SUFFIX", ""))
+        except AssertionError:
+            raise  # parity failure: NEVER mask a correctness regression
+        except Exception as e:
+            if _is_resource_error(e) or _is_backend_drop(e):
+                print("reduce leg: %s: %s" % (type(e).__name__, e),
+                      file=sys.stderr)
+                sys.exit(EXIT_CAPACITY)
+            raise
+        return
     if os.environ.get("GS_BENCH_CHILD"):
         # child mode (checked FIRST — a child must never re-enter the
         # scale ladder): the parent already probed the backend and
@@ -493,6 +568,17 @@ def main():
         # lines must be impossible
         sys.exit(rc or 1)
 
+    # BASELINE config #2's measured leg (columnar reduceOnEdges) — a
+    # watchdogged child like the scales; capacity/timeout keeps the
+    # triangle lines, a parity failure still fails the bench
+    rc = run_scale_watchdogged(0.0, metric_suffix,
+                               extra_env={"GS_BENCH_REDUCE": "1"})
+    if rc not in (0, EXIT_CAPACITY, EXIT_TIMEOUT):
+        sys.exit(rc)
+    if rc:
+        print("reduce leg rc=%d (capacity/timeout); triangle scales "
+              "kept" % rc, file=sys.stderr)
+
 
 EXIT_CAPACITY = 3
 EXIT_TIMEOUT = 4
@@ -511,15 +597,20 @@ def run_one_scale_child(attempt: float, metric_suffix: str) -> None:
         raise
 
 
-def run_scale_watchdogged(attempt: float, metric_suffix: str) -> int:
-    """Run one scale in a subprocess with a hard timeout, streaming its
-    stdout through. A hung remote compile gets SIGKILLed (process
-    group) instead of stalling the whole bench."""
+def run_scale_watchdogged(attempt: float, metric_suffix: str,
+                          extra_env: dict = None) -> int:
+    """Run one scale (or, with extra_env, another bench leg) in a
+    subprocess with a hard timeout, streaming its stdout through. A
+    hung remote compile gets SIGKILLed (process group) instead of
+    stalling the whole bench."""
     import signal
 
     timeout_s = int(os.environ.get("GS_BENCH_SCALE_TIMEOUT", "1500"))
-    env = dict(os.environ, GS_BENCH_CHILD=repr(attempt),
-               GS_BENCH_SUFFIX=metric_suffix)
+    env = dict(os.environ, GS_BENCH_SUFFIX=metric_suffix)
+    if extra_env:
+        env.update(extra_env)
+    else:
+        env["GS_BENCH_CHILD"] = repr(attempt)
     p = subprocess.Popen([sys.executable] + sys.argv, env=env,
                          stdout=subprocess.PIPE, text=True,
                          start_new_session=True)

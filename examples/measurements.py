@@ -8,7 +8,9 @@ streaming path.
 Usage: measurements.py [<workload> [<edges file> [window]]] [--sharded]
        [--fused] [--cpu]
 
-  workload: degrees | cc | bipartite | triangles | all   (default all)
+  workload: degrees | cc | bipartite | triangles | reduce | all
+            (default all; `reduce` = BASELINE config #2's
+            reduceOnEdges sum-of-weights on the columnar engine)
   window:   edges per count-based window (default 65536)
   --fused:  run ALL analytics in one carried-state scan program per
             64-window chunk (ops/scan_analytics.py) — the minimal-
@@ -100,6 +102,63 @@ def measure_fused(src, dst, window_edges: int):
     }
 
 
+def measure_reduce(src, dst, window_edges: int, mesh=None,
+                   direction: str = "out"):
+    """BASELINE.json config #2: `reduceOnEdges` sum-of-weights over
+    tumbling count windows, on the columnar engine
+    (ops/windowed_reduce.py; reference hot loop
+    GraphWindowStream.java:101-121) — single-chip, or the sharded pane
+    form (panes_per_window=1) over a mesh."""
+    import numpy as np
+
+    # deterministic synthetic weights (the SNAP streams carry none)
+    val = (1 + (src + 3 * dst) % 97).astype(np.int32)
+    vb = int(max(src.max(), dst.max())) + 1
+    if mesh is not None:
+        from gelly_streaming_tpu.parallel.sharded import \
+            ShardedWindowEngine
+
+        eng = ShardedWindowEngine(mesh, num_vertices_bucket=vb)
+        num_w = -(-len(src) // window_edges)
+        pane = (np.arange(len(src)) // window_edges).astype(np.int64)
+        # warm the exact program (same pane bucket + value shape)
+        eng.sliding_reduce(src, np.zeros_like(pane), val,
+                           num_panes=num_w, panes_per_window=1)
+        t0 = time.perf_counter()
+        wv, wc = eng.sliding_reduce(src, pane, val, num_panes=num_w,
+                                    panes_per_window=1)
+        elapsed = time.perf_counter() - t0
+        windows = num_w
+    else:
+        from gelly_streaming_tpu.ops.windowed_reduce import \
+            WindowedEdgeReduce
+
+        eng = WindowedEdgeReduce(vertex_bucket=vb,
+                                 edge_bucket=window_edges,
+                                 name="sum", direction=direction)
+        eb = eng.eb
+        # warm every chunk shape the timed run dispatches (full chunks
+        # + the bucketed ragged tail), zeros streams — same discipline
+        # as measure_fused
+        num_w = -(-len(src) // eb)
+        for w in {min(num_w, eng.MAX_STREAM_WINDOWS),
+                  num_w % eng.MAX_STREAM_WINDOWS}:
+            if w:
+                z = np.zeros(w * eb, np.int64)
+                eng.process_stream(z, z, np.zeros(w * eb, np.int32))
+        t0 = time.perf_counter()
+        results = eng.process_stream(src, dst, val)
+        elapsed = time.perf_counter() - t0
+        windows = len(results)
+    return {
+        "workload": "reduce_on_edges(sum-of-weights, %s)" % direction,
+        "edges_per_sec": round(len(src) / elapsed),
+        "windows": windows,
+        "window_edges": window_edges,
+        "edges": len(src),
+    }
+
+
 def main(argv):
     sharded = "--sharded" in argv
     fused = "--fused" in argv
@@ -130,10 +189,15 @@ def main(argv):
                      "drop the workload argument or the flag")
         print(json.dumps(measure_fused(src, dst, window_edges)))
         return
-    names = (["degrees", "cc", "bipartite", "triangles"]
+    names = (["degrees", "cc", "bipartite", "triangles", "reduce"]
              if workload == "all" else [workload])
     for name in names:
-        print(json.dumps(measure(name, src, dst, window_edges, mesh)))
+        if name == "reduce":
+            print(json.dumps(measure_reduce(src, dst, window_edges,
+                                            mesh)))
+        else:
+            print(json.dumps(measure(name, src, dst, window_edges,
+                                     mesh)))
 
 
 if __name__ == "__main__":

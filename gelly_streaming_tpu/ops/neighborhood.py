@@ -262,26 +262,37 @@ def masked_combine(fn, av, ap, nv, npn):
 @functools.lru_cache(maxsize=256)
 def _jit_assoc_combine(fn, wp: int):
     """Jitted masked window combine for a generic associative fn: no
-    identity element exists in general, so empty (pane, vertex) cells
-    are carried as a presence mask and the combine selects
-    fn(acc, next) / next / acc per cell. Cached per (fn, wp) like the
+    identity element exists in general for the VALUES, so empty
+    (pane, vertex) cells are carried as a presence mask and the combine
+    selects fn(acc, next) / next / acc per cell. COUNTS do have an
+    identity (0) even when values don't, so this tier returns real
+    per-cell edge counts like the monoid tier — `run(cells, counts)`
+    -> (win_vals, win_counts), win_counts the summed pane counts
+    (ADVICE r3: a caller switching name='min' to fn=jnp.minimum must
+    not silently lose count information). Cached per (fn, wp) like the
     segment kernels."""
     import jax
     import jax.numpy as jnp
 
     @jax.jit
-    def run(cells, present):
+    def run(cells, counts):
         cols = cells.shape[1]
+        present = counts > 0
         pad_v = jnp.zeros((wp - 1, cols), cells.dtype)
         pad_p = jnp.zeros((wp - 1, cols), jnp.bool_)
+        pad_c = jnp.zeros((wp - 1, cols), counts.dtype)
         pv = jnp.concatenate([pad_v, cells, pad_v])
         pp = jnp.concatenate([pad_p, present, pad_p])
+        pc = jnp.concatenate([pad_c, counts, pad_c])
 
         # fn runs elementwise on every cell (garbage in absent slots);
         # masked_combine keeps only the licensed results
-        return _combine_shifted(
+        accv, _ = _combine_shifted(
             pv, pp, wp,
             lambda av, ap, nv, npn: masked_combine(fn, av, ap, nv, npn))
+        accc, _ = _combine_shifted(
+            pc, pc, wp, lambda av, ac, nv, nc: (av + nv, ac))
+        return accv, accc
 
     return run
 
@@ -371,11 +382,16 @@ def _make_pane_reduce(per_window_kernel, name: str = None, fn=None):
             accv, accc = window_stack_combine(part, counts, wp, name)
         else:
             order = np.argsort(seg, kind="stable")
-            res, has_any = seg_ops.segmented_reduce_associative(
+            res, _has_any = seg_ops.segmented_reduce_associative(
                 fn, seg[order], val[order], n_cells)
             part = jnp.asarray(res).reshape(pb, sb + 1)
-            present = jnp.asarray(has_any).reshape(pb, sb + 1)
-            accv, accc = _jit_assoc_combine(fn, wp)(part, present)
+            # real per-cell edge counts (identity 0 exists for counts
+            # even when fn has none) — same win_counts semantics as
+            # the monoid tier
+            cnt = np.bincount(seg, minlength=n_cells).astype(
+                np.int32).reshape(pb, sb + 1)
+            accv, accc = _jit_assoc_combine(fn, wp)(part,
+                                                    jnp.asarray(cnt))
         accv, accc = np.asarray(accv), np.asarray(accc)
 
         # emit only occupied (window, vertex) cells, vectorized — a

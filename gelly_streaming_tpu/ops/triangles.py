@@ -300,28 +300,35 @@ def orient_by_degree(s: jax.Array, d: jax.Array, deg: jax.Array,
     return jnp.where(swap, hi, lo), jnp.where(swap, lo, hi)
 
 
-def dedupe_pairs(a: jax.Array, b: jax.Array, sent: int):
-    """Lexicographic sort + first-occurrence dedupe; duplicates become
-    (sent, sent) and a final re-sort leaves the survivors contiguous."""
+def dedupe_and_positions(a: jax.Array, b: jax.Array, sent: int, vb: int):
+    """Fused dedupe + CSR positions in ONE sort: lexicographic sort of
+    (a, b), first-occurrence marking, and each valid edge's column
+    rank among the VALID edges of its source via a prefix count —
+    duplicates stay in place behind the `evalid` mask instead of being
+    re-sorted to the tail. Replaces the round-3 sort→mark→re-sort→
+    segment_min sequence in both window counters, deleting the second
+    O(E log E) sort from the hot path (the round-3 device trace put the two sorts
+    at ~35% of an eb=32768 CPU dispatch; the chip pays them too).
+
+    Returns (a_sorted, b_sorted, evalid, pos); pos is garbage where
+    ~evalid — callers mask. Within each source run the valid b's are
+    ascending and their positions are 0..deg-1 consecutively, so the
+    scattered neighbor rows keep the sorted-row contract the binary-
+    search intersect requires."""
     a, b = jax.lax.sort((a, b), num_keys=2)
     first = jnp.concatenate([
         jnp.array([True]),
         (a[1:] != a[:-1]) | (b[1:] != b[:-1]),
     ])
     evalid = first & (a < sent)
-    a = jnp.where(evalid, a, sent)
-    b = jnp.where(evalid, b, sent)
-    return jax.lax.sort((a, b), num_keys=2)
-
-
-def csr_positions(a: jax.Array, sent: int, vb: int):
-    """Per-edge column index within its source's contiguous run (edges
-    must be sorted by (a, b))."""
     n = a.shape[0]
     idx = jnp.arange(n)
     seg_first = jax.ops.segment_min(
         jnp.where(a < sent, idx, n), a, vb + 1)
-    return idx - seg_first[a]
+    ev = evalid.astype(jnp.int32)
+    before = jnp.cumsum(ev) - ev     # valid edges strictly before i
+    pos = before - before[jnp.clip(seg_first[a], 0, n - 1)]
+    return a, b, evalid, pos
 
 
 def build_window_counter(vb: int, kb: int):
@@ -348,11 +355,11 @@ def build_window_counter(vb: int, kb: int):
         # ---- orient low(deg, id) -> high(deg, id)
         a, b = orient_by_degree(src, dst, deg, sent)
 
-        # ---- sort/dedupe, then CSR column positions within runs
-        a, b = dedupe_pairs(a, b, sent)
-        pos = csr_positions(a, sent, vb)
-        overflow = jnp.sum((pos >= kb) & (a < sent))
-        ok = (a < sent) & (pos < kb)
+        # ---- fused sort/dedupe + CSR column positions (one sort;
+        # duplicates stay masked in place)
+        a, b, evalid, pos = dedupe_and_positions(a, b, sent, vb)
+        overflow = jnp.sum((pos >= kb) & evalid)
+        ok = evalid & (pos < kb)
         rows = jnp.where(ok, a, vb)
         cols = jnp.clip(pos, 0, kb - 1)
         nbr = jnp.full((vb + 1, kb), sent, jnp.int32)
@@ -360,13 +367,13 @@ def build_window_counter(vb: int, kb: int):
             jnp.where(ok, b, sent).astype(jnp.int32))
 
         # ---- neighbor-row intersection at each oriented edge
+        # (duplicate slots carry real ids now; evalid masks them out)
         # (an optimization_barrier before the intersect wins ~20% on a
         # single-window CPU microbenchmark at K=32 but measures FLAT
         # through the lax.map streaming form the bench actually runs —
         # tried and reverted in round 3; re-evaluate on chip)
-        emask = a < sent
         count = intersect(nbr, a.astype(jnp.int32),
-                          b.astype(jnp.int32), emask)
+                          b.astype(jnp.int32), evalid)
         return count, overflow
 
     return run
@@ -375,6 +382,41 @@ def build_window_counter(vb: int, kb: int):
 # ----------------------------------------------------------------------
 # streaming fixed-shape engine: the whole window pipeline on device
 # ----------------------------------------------------------------------
+
+_STREAM_IMPL = None   # "device" | "host", resolved once per process
+
+
+def _resolve_stream_impl() -> str:
+    """Streaming-counter tier: the device (XLA) kernel by default; the
+    vectorized numpy kernel (ops/host_triangles.py) only when (a) this
+    process runs a CPU backend — on chip the device kernel always
+    stands — and (b) committed backend-matched measurements (PERF.json
+    `host_stream` section, tools/profile_kernels.py) show the host
+    form at parity and ≥5% faster at EVERY measured bucket. Same
+    measured-default policy as the dense/Pallas/intersect selections:
+    the CPU fallback floor is allowed to pick the implementation that
+    actually wins on a CPU, but only on committed evidence."""
+    global _STREAM_IMPL
+    if _STREAM_IMPL is not None:
+        return _STREAM_IMPL
+    impl = "device"
+    try:
+        import jax as _jax
+
+        if _jax.default_backend() == "cpu":
+            perf = _load_matching_perf("cpu")
+            rows = (perf or {}).get("host_stream", [])
+            if (isinstance(rows, list) and rows
+                    and all(r.get("parity") is True
+                            and (r.get("host_edges_per_s") or 0)
+                            >= 1.05 * (r.get("device_edges_per_s") or 0)
+                            for r in rows)):
+                impl = "host"
+    except Exception:
+        pass
+    _STREAM_IMPL = impl
+    return impl
+
 
 _TUNED_KB = {}  # eb -> measured starting K (resolved once per process)
 
@@ -408,17 +450,21 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
     """Shared selection core of _tuned_kb/_tuned_chunk: the fastest
     measured row (min per_window_ms, recount cost included in the
     measurement) of this bucket's backend-matched committed sweep;
-    `default` when unmeasured."""
+    `default` when unmeasured. Sweep rows missing the value key (a
+    malformed or hand-edited PERF.json) are skipped, and the selected
+    value is clamped to a positive int — a zero/None K or chunk would
+    break the kernel's range stepping (ADVICE r3)."""
     perf = _load_matching_perf()
     if perf is not None:
         for row in perf.get("window", []):
             if row.get("edge_bucket") != eb:
                 continue
             measured = [s for s in row.get(sweep_key, [])
-                        if s.get("per_window_ms")]
+                        if s.get("per_window_ms") and s.get(value_key)]
             if measured:
-                default = min(measured,
-                              key=lambda s: s["per_window_ms"])[value_key]
+                default = max(1, int(min(
+                    measured,
+                    key=lambda s: s["per_window_ms"])[value_key]))
     return default
 
 _TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch
@@ -591,7 +637,10 @@ class TriangleWindowKernel:
         asserts. Compile-only — no dispatches, no compute (the first
         execute-based version cost ~16% of the 10M driver leg running
         full-size zero streams). seg_ops.warm_stream_buckets is the
-        shared body."""
+        shared body. A no-op when the numpy tier is selected — there
+        is nothing to compile."""
+        if _resolve_stream_impl() == "host":
+            return
         seg_ops.warm_stream_buckets(self)
 
     def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
@@ -600,11 +649,23 @@ class TriangleWindowKernel:
         MAX_STREAM_WINDOWS windows: one h2d of the COO chunk, a
         `lax.map` over its windows, one d2h of the counts. Windows whose
         hubs overflow K are recounted individually (escalating count()),
-        so results are always exact."""
+        so results are always exact. On a CPU backend with committed
+        winning measurements the vectorized numpy tier takes over
+        (`_resolve_stream_impl`; same counts, no dispatches)."""
         src = np.asarray(src, np.int32)
         dst = np.asarray(dst, np.int32)
         if len(src) == 0:
             return []
+        if _resolve_stream_impl() == "host":
+            from . import host_triangles
+
+            return host_triangles.count_stream(src, dst, self.eb)
+        return self._count_stream_device(src, dst)
+
+    def _count_stream_device(self, src: np.ndarray,
+                             dst: np.ndarray) -> list:
+        """The device path of count_stream, selection bypassed (the
+        profiler measures both tiers through this split)."""
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
                                                   sentinel=self.vb)
         eb = self.eb
@@ -616,9 +677,14 @@ class TriangleWindowKernel:
         """Exact counts of a list of (src, dst) window batches of
         varying lengths (each ≤ edge_bucket), padded into one stack and
         dispatched in chunks — the batched form of calling count() per
-        window (used by the driver's event-time windows)."""
+        window (used by the driver's event-time windows). Routes to the
+        numpy tier under the same selection as count_stream."""
         if not windows:
             return []
+        if _resolve_stream_impl() == "host":
+            from . import host_triangles
+
+            return host_triangles.count_windows(windows)
         s, d, valid = seg_ops.stack_window_list(windows, self.eb,
                                                 self.vb)
         return self._run_stack(s, d, valid, lambda w: windows[w])

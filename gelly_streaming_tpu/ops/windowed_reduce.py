@@ -1,0 +1,308 @@
+"""Columnar windowed neighborhood reduce — `reduceOnEdges` /
+`foldNeighbors` at stream rate (BASELINE.json config #2).
+
+The record-level runtime executes the generic neighborhood UDFs by
+handing per-edge Python `Edge` lists to a kernel per window
+(core/runtime.py) — exact reference semantics
+(GraphWindowStream.java:101-121), but interpreter-bound. This engine is
+the production columnar form: interned COO windows (src, dst, value
+arrays) flow straight into the flattened (window, vertex) segment
+kernels — the SAME cell trick as the sliding pane path
+(ops/neighborhood.py `_make_pane_reduce` with panes = tumbling
+windows), so one fixed-shape device dispatch reduces an entire
+windows_per_dispatch stack of windows with zero per-edge Python.
+
+Monoid names ('sum'|'min'|'max') run the parallel segment kernels;
+a user fn DECLARED associative runs the flagged associative scan
+(seg_ops.segmented_reduce_associative). Direction follows the
+reference's EdgeDirection: OUT groups by src, IN by dst, ALL by both
+(each edge contributes its value to both endpoints' neighborhoods —
+SimpleEdgeStream.java slice(ALL) duplicates exactly this way).
+
+Multi-chip: `parallel.sharded.make_sharded_pane_reduce(mesh, vb, pb,
+panes_per_window=1, name)` IS this engine's sharded form (a tumbling
+window is a sliding window with one pane); ShardedWindowEngine
+.sliding_reduce exposes it.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import numpy as np
+
+from . import segment as seg_ops
+
+_DIRECTIONS = ("out", "in", "all")
+
+
+_REDUCE_IMPL = {}   # name -> "device" | "host", resolved once per process
+
+
+def _resolve_reduce_impl(name: str) -> str:
+    """Columnar-reduce tier for monoid `name`: the device segment
+    kernels by default; the vectorized host kernel (flattened
+    one-bincount-per-chunk for sum, ufunc.at otherwise) only on a CPU
+    backend with committed backend-matched `host_reduce` rows showing
+    parity and a ≥5% win for this name at every measured bucket — the
+    same measured-default policy as `triangles._resolve_stream_impl`
+    (a CPU fallback may select the kernel that actually wins on a CPU;
+    the chip path is untouched)."""
+    if name in _REDUCE_IMPL:
+        return _REDUCE_IMPL[name]
+    impl = "device"
+    try:
+        import jax as _jax
+
+        from .triangles import _load_matching_perf
+
+        if _jax.default_backend() == "cpu":
+            perf = _load_matching_perf("cpu")
+            rows = [r for r in (perf or {}).get("host_reduce", [])
+                    if r.get("name") == name]
+            if rows and all(r.get("parity") is True
+                            and (r.get("host_edges_per_s") or 0)
+                            >= 1.05 * (r.get("device_edges_per_s") or 0)
+                            for r in rows):
+                impl = "host"
+    except Exception:
+        pass
+    _REDUCE_IMPL[name] = impl
+    return impl
+
+
+class WindowedEdgeReduce:
+    """Per-window per-vertex reduce over tumbling `edge_bucket`-sized
+    windows of a COO value stream.
+
+    `process_stream(src, dst, val)` -> list of (values, counts), one
+    pair per window; values[v] is the reduce of the window's edges
+    incident to dense vertex v in the given direction, counts[v] the
+    number of contributing edges (0 = vertex absent — min/max cells
+    hold the fill, mask by counts like the pane path).
+
+    One jitted program per windows-per-dispatch bucket over fixed
+    [wb, eb] shapes — steady-state streaming recompiles nothing
+    (the same dispatch economics as TriangleWindowKernel). On a CPU
+    backend with committed winning measurements the monoid tier routes
+    through the vectorized host kernel instead
+    (`_resolve_reduce_impl`; same cells/counts, no dispatches).
+    """
+
+    MAX_STREAM_WINDOWS = 64
+
+    def __init__(self, vertex_bucket: int, edge_bucket: int,
+                 name: str = "sum", direction: str = "out",
+                 fn=None):
+        if direction not in _DIRECTIONS:
+            raise ValueError(f"direction must be one of {_DIRECTIONS}")
+        if fn is not None:
+            name = None
+        assert name in (None, "sum", "min", "max"), name
+        self.vb = seg_ops.bucket_size(vertex_bucket)
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self.name = name
+        self.fn = fn
+        self.direction = direction
+        self._fns = {}
+
+    # ---- jitted stack program (monoid tier) ---------------------------
+
+    def _stack_fn(self, wb: int):
+        fn = self._fns.get(wb)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            vbp = self.vb + 1
+            n_cells = wb * vbp
+            name = self.name
+
+            @jax.jit
+            def run(ids, vals):
+                cells = seg_ops.segment_reduce(
+                    vals, ids, n_cells + 1, name)[:-1].reshape(wb, vbp)
+                counts = jax.ops.segment_sum(
+                    jnp.where(ids < n_cells, 1, 0), ids,
+                    n_cells + 1)[:-1].reshape(wb, vbp)
+                return cells, counts
+
+            self._fns[wb] = fn = run
+        return fn
+
+    def _cell_ids(self, src, dst, win, valid, vbp, n_cells):
+        """Flattened (window, vertex) cell id per contribution; ALL
+        direction doubles the stream (one contribution per endpoint)."""
+        if self.direction == "out":
+            vtx = [src]
+        elif self.direction == "in":
+            vtx = [dst]
+        else:
+            vtx = [src, dst]
+        ids, rep = [], len(vtx)
+        for v in vtx:
+            ids.append(np.where(valid, win * vbp + v, n_cells))
+        return np.concatenate(ids), rep
+
+    def process_stream(self, src: np.ndarray, dst: np.ndarray,
+                       val: np.ndarray) -> List[Tuple[np.ndarray,
+                                                      np.ndarray]]:
+        src = np.asarray(src, np.int64)
+        dst = np.asarray(dst, np.int64)
+        val = np.asarray(val)
+        assert len(src) == len(dst) == len(val)
+        n = len(src)
+        if n == 0:
+            return []
+        if (self.name is not None
+                and _resolve_reduce_impl(self.name) == "host"):
+            return self._host_process_stream(src, dst, val)
+        return self._device_process_stream(src, dst, val)
+
+    def _device_process_stream(self, src, dst, val):
+        """The device path, selection bypassed (the profiler measures
+        both tiers through this split)."""
+        n = len(src)
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        eb, vbp = self.eb, self.vb + 1
+        num_w = -(-n // eb)
+        at = 0
+        while at < num_w:
+            wb = min(self.MAX_STREAM_WINDOWS, num_w - at)
+            wb = seg_ops.bucket_size(wb)   # O(log) programs over tails
+            lo, hi = at * eb, min((at + wb) * eb, n)
+            s = seg_ops.pad_to(src[lo:hi], wb * eb)
+            d = seg_ops.pad_to(dst[lo:hi], wb * eb)
+            v = seg_ops.pad_to(val[lo:hi], wb * eb)
+            valid = seg_ops.pad_to(np.ones(hi - lo, bool), wb * eb,
+                                   fill=False)
+            win = np.arange(wb * eb) // eb
+            n_cells = wb * vbp
+            ids, rep = self._cell_ids(s, d, win, valid, vbp, n_cells)
+            vals = np.concatenate([v] * rep)
+            if self.name is not None:
+                import jax.numpy as jnp
+
+                cells, counts = self._stack_fn(wb)(
+                    jnp.asarray(ids), jnp.asarray(vals))
+                cells, counts = np.asarray(cells), np.asarray(counts)
+            else:
+                order = np.argsort(ids, kind="stable")
+                res, _has = seg_ops.segmented_reduce_associative(
+                    self.fn, ids[order], vals[order], n_cells)
+                cells = np.asarray(res).reshape(wb, vbp)
+                counts = np.bincount(
+                    ids[ids < n_cells],
+                    minlength=n_cells).reshape(wb, vbp)
+            real_w = min(wb, num_w - at)
+            for w in range(real_w):
+                out.append((cells[w], counts[w]))
+            at += wb
+        return out
+
+    # ---- host (numpy) tier -------------------------------------------
+
+    def _host_process_stream(self, src, dst, val):
+        """Vectorized host form of the monoid tiers, selection bypassed
+        (the profiler measures both tiers through this split): one
+        flattened (window, vertex)-cell bincount per chunk for 'sum'
+        (falling back to exact ufunc.at when float64 accumulation
+        could round an integer sum), ufunc.at for 'min'/'max'. Same
+        cells/counts as the device tier."""
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        eb, vbp = self.eb, self.vb + 1
+        n = len(src)
+        num_w = -(-n // eb)
+        ident = {"sum": 0,
+                 "min": (np.iinfo(val.dtype).max
+                         if np.issubdtype(val.dtype, np.integer)
+                         else np.inf),
+                 "max": (np.iinfo(val.dtype).min
+                         if np.issubdtype(val.dtype, np.integer)
+                         else -np.inf)}[self.name]
+        # The bincount fast path accumulates in float64, then casts
+        # back. For integer values that is used only when the worst-
+        # case cell sum (max|val| × contributions per cell — direction
+        # 'all' gives every cell up to 2·eb of them) is exact in
+        # float64 AND fits val.dtype: a sum that would overflow the
+        # dtype must take the ufunc.at path, whose numpy integer
+        # arithmetic wraps modularly exactly like the device
+        # segment_sum (out-of-range float→int casts are undefined).
+        per_cell = eb * (2 if self.direction == "all" else 1)
+        if np.issubdtype(val.dtype, np.integer):
+            limit = min(1 << 53, int(np.iinfo(val.dtype).max))
+            exact_bincount = (self.name == "sum" and n > 0
+                              and int(np.abs(val).max()) * per_cell
+                              <= limit)
+        else:
+            exact_bincount = self.name == "sum"
+        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
+            hi_w = min(at + self.MAX_STREAM_WINDOWS, num_w)
+            lo, hi = at * eb, min(hi_w * eb, n)
+            s, d, v = src[lo:hi], dst[lo:hi], val[lo:hi]
+            win = np.arange(hi - lo) // eb
+            if self.direction == "out":
+                vtx = [s]
+            elif self.direction == "in":
+                vtx = [d]
+            else:
+                vtx = [s, d]
+            ids = np.concatenate([win * vbp + x for x in vtx])
+            vals = np.concatenate([v] * len(vtx))
+            wb = hi_w - at
+            n_cells = wb * vbp
+            counts = np.bincount(ids, minlength=n_cells).reshape(
+                wb, vbp)
+            if exact_bincount:
+                cells = np.bincount(
+                    ids, weights=vals,
+                    minlength=n_cells).astype(val.dtype).reshape(
+                    wb, vbp)
+            else:
+                op = {"sum": np.add, "min": np.minimum,
+                      "max": np.maximum}[self.name]
+                flat = np.full(n_cells, ident, val.dtype)
+                op.at(flat, ids, vals)
+                cells = flat.reshape(wb, vbp)
+            for w in range(wb):
+                out.append((cells[w], counts[w]))
+        return out
+
+
+def numpy_reference(src, dst, val, eb: int, direction: str = "out",
+                    name: str = "sum"
+                    ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Faithful per-window host port of the reference's windowed
+    neighborhood reduce (GraphWindowStream.java:101-121): a per-edge
+    fold into a per-vertex slot — the comparison baseline the measured
+    leg reports against, and the parity oracle for fuzz tests. Cells
+    with count 0 hold the monoid identity (cross-check counts, not
+    values, for absence)."""
+    op = {"sum": np.add, "min": np.minimum, "max": np.maximum}[name]
+    ident = {"sum": 0,
+             "min": (np.iinfo(np.asarray(val).dtype).max
+                     if np.issubdtype(np.asarray(val).dtype, np.integer)
+                     else np.inf),
+             "max": (np.iinfo(np.asarray(val).dtype).min
+                     if np.issubdtype(np.asarray(val).dtype, np.integer)
+                     else -np.inf)}[name]
+    src = np.asarray(src, np.int64)
+    dst = np.asarray(dst, np.int64)
+    val = np.asarray(val)
+    nv = int(max(src.max(), dst.max())) + 1 if len(src) else 1
+    out = []
+    for lo in range(0, len(src), eb):
+        s, d, v = src[lo:lo + eb], dst[lo:lo + eb], val[lo:lo + eb]
+        if direction == "out":
+            pairs = [(s, v)]
+        elif direction == "in":
+            pairs = [(d, v)]
+        else:
+            pairs = [(s, v), (d, v)]
+        acc = np.full(nv, ident, val.dtype)
+        cnt = np.zeros(nv, np.int64)
+        for vtx, vv in pairs:
+            op.at(acc, vtx, vv)
+            np.add.at(cnt, vtx, 1)
+        out.append((acc, cnt))
+    return out

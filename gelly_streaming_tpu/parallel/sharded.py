@@ -150,10 +150,13 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
 
     Returns jitted fn(src, pane, val, valid) -> (win_vals, win_counts),
     both [pane_bucket + panes_per_window - 1, vertex_bucket + 1]; a
-    (window, vertex) cell is meaningful iff win_counts[w, v] > 0. In
-    `name` mode win_counts are edge counts (min/max cells left at
-    their identity otherwise); in `fn` mode they are 0/1 presence
-    flags. Window w covers dense panes [w - panes_per_window + 1, w];
+    (window, vertex) cell is meaningful iff win_counts[w, v] > 0.
+    win_counts are real edge counts in BOTH tiers — counts have an
+    identity (0) even when values don't, so the fn tier psums a
+    segment_sum of valid edges alongside its value fold (ADVICE r3:
+    switching name='min' to fn=jnp.minimum must not silently change
+    count semantics). Window w covers dense panes
+    [w - panes_per_window + 1, w];
     src/pane/val/valid are edge-sharded arrays (pad with valid=False).
     """
     assert (name is None) != (fn is None)
@@ -205,6 +208,14 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
     )
     def assoc_partials(src, pane, val, valid):
         ids = jnp.where(valid, pane * vbp + src, n_cells)
+        # real per-cell edge counts (identity 0 exists for counts even
+        # when fn has none): ONE extra psum next to the all_gather
+        # below, and the fn tier's win_counts match the monoid tier's
+        # (ADVICE r3)
+        counts = jax.lax.psum(
+            jax.ops.segment_sum(jnp.where(valid, 1, 0), ids,
+                                n_cells + 1)[:-1].reshape(pb, vbp),
+            SHARD_AXIS)
         order = jnp.argsort(ids, stable=True)
         ids_s = ids[order]
         vals_s = val[order]
@@ -251,21 +262,20 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
                 nxt_v.append(vals[-1])
                 nxt_p.append(pres[-1])
             vals, pres = nxt_v, nxt_p
-        accv, accp = vals[0], pres[0]
-        # every shard folded the same gathered partials, so accv/accp
-        # are value-identical everywhere; the no-op pmax makes that
+        accv = vals[0]
+        # every shard folded the same gathered partials, so accv is
+        # value-identical everywhere; the no-op pmax makes that
         # replication explicit for shard_map's vma check (the [pb, vbp]
-        # payload is tiny next to the all_gather above)
+        # payload is tiny next to the all_gather above). counts is
+        # already replicated by its psum.
         accv = jax.lax.pmax(accv, SHARD_AXIS)
-        accp = jax.lax.pmax(accp.astype(jnp.int32), SHARD_AXIS) > 0
-        return accv, accp
+        return accv, counts
 
     def run(src, pane, val, valid):
         from ..ops.neighborhood import _jit_assoc_combine
 
-        cells, present = assoc_partials(src, pane, val, valid)
-        accv, accp = _jit_assoc_combine(fn, wp)(cells, present)
-        return accv, accp.astype(jnp.int32)
+        cells, counts = assoc_partials(src, pane, val, valid)
+        return _jit_assoc_combine(fn, wp)(cells, counts)
 
     return jax.jit(run)
 
@@ -273,6 +283,16 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
 # ----------------------------------------------------------------------
 # full sharded window triangle pipeline (P1 + P6: all_to_all + pmax + psum)
 # ----------------------------------------------------------------------
+
+_TABLE_MODE = None  # resolved once per process (reset: _reset_table_mode)
+
+
+def _reset_table_mode() -> None:
+    """Test hook: forget the memoized table-mode selection so a test
+    can re-resolve against a different committed PERF.json."""
+    global _TABLE_MODE
+    _TABLE_MODE = None
+
 
 def resolve_table_mode() -> str:
     """Neighbor-row distribution mode for the sharded window counter
@@ -283,7 +303,18 @@ def resolve_table_mode() -> str:
     committed measurement shows the owner gather ≥5% faster, the
     proven replicated table stands. The mode only matters on n>1
     meshes (the virtual CPU mesh here; real ICI when multi-chip
-    hardware exists — window_collective_bytes models that side)."""
+    hardware exists — window_collective_bytes models that side).
+    Memoized per process like the other measurement-driven selections
+    (the driver rebuilds kernels on reconfiguration; re-reading
+    PERF.json each time is needless I/O — ADVICE r3)."""
+    global _TABLE_MODE
+    if _TABLE_MODE is not None:
+        return _TABLE_MODE
+    _TABLE_MODE = _resolve_table_mode_uncached()
+    return _TABLE_MODE
+
+
+def _resolve_table_mode_uncached() -> str:
     perf = triangles._load_matching_perf()
     if perf is not None:
         row = perf.get("sharded_table", {})
@@ -456,13 +487,12 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
             split_axis=0, concat_axis=0, tiled=True).reshape(n * cap)
 
         # ---- local dedupe of owned edges (global dedup by ownership)
-        ra, rb = triangles.dedupe_pairs(recv_a, recv_b, sent)
-
-        # ---- CSR scatter of this shard's owned edges into its kb/n
-        # column slice
-        pos2 = triangles.csr_positions(ra, sent, vb)
-        k_overflow = jnp.sum((pos2 >= kslice) & (ra < sent))
-        ok2 = (ra < sent) & (pos2 < kslice)
+        # + CSR positions, fused into one sort (duplicates stay in
+        # place behind rvalid)
+        ra, rb, rvalid, pos2 = triangles.dedupe_and_positions(
+            recv_a, recv_b, sent, vb)
+        k_overflow = jnp.sum((pos2 >= kslice) & rvalid)
+        ok2 = rvalid & (pos2 < kslice)
         rows = jnp.where(ok2, ra, vb)
         cols_local = jnp.clip(pos2, 0, kslice - 1)
 
@@ -473,7 +503,7 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
                 jnp.where(ok2, rb, -1))
             nbr = jax.lax.pmax(partial, axis)
             nbr = jnp.where(nbr < 0, sent, nbr)
-            local = intersect(nbr, ra, rb, ra < sent)
+            local = intersect(nbr, ra, rb, rvalid)
         else:
             # ---- collective #3 (owner-local): gather only the rows
             # this shard's owned edges touch. Requests are ALIGNED to
@@ -495,7 +525,7 @@ def build_sharded_window_counter(n: int, eb: int, vb: int, kb: int,
             rows_full = jnp.transpose(recv, (1, 0, 2)).reshape(2 * m, kb)
             rows_full = jnp.where(rows_full < 0, sent, rows_full)
             local = triangles.intersect_rows(
-                rows_full[:m], rows_full[m:], ra < sent, sent)
+                rows_full[:m], rows_full[m:], rvalid, sent)
         count = jax.lax.psum(local, axis)
         # separate signals so the host widens only the dimension that
         # overflowed (cap vs K): each (kb, cap) pair is a fresh compile
@@ -827,8 +857,8 @@ class ShardedWindowEngine:
         tiers as the single-chip pane path. Returns numpy
         (win_vals, win_counts), both
         [pane_bucket + panes_per_window - 1, vb + 1]; a (w, v) cell is
-        meaningful iff win_counts[w, v] > 0 (edge counts for monoids,
-        0/1 presence for fns), window w covering panes
+        meaningful iff win_counts[w, v] > 0 (real edge counts in both
+        tiers), window w covering panes
         [w - panes_per_window + 1, w]. Programs are cached per
         (pane_bucket, panes_per_window, combine), so steady-state
         streaming pays zero recompilation."""

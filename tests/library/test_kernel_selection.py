@@ -33,6 +33,7 @@ def selection_env(tmp_path, monkeypatch):
     monkeypatch.setattr(triangles, "_DENSE_CHOICE", None)
     monkeypatch.setattr(triangles, "_TUNED_KB", {})
     monkeypatch.setattr(triangles, "_TUNED_CHUNK", {})
+    monkeypatch.setattr(triangles, "_STREAM_IMPL", None)
 
     def configure(file_backend, process_backend, **sections):
         perf_path.write_text(
@@ -159,3 +160,63 @@ def test_tuned_chunk_backend_mismatch_keeps_default(selection_env):
                          "per_window_ms": 1.0}]}])
     assert (triangles._tuned_chunk(8192)
             == triangles.TriangleWindowKernel.MAX_STREAM_WINDOWS)
+
+
+def test_sweep_rows_missing_value_key_are_skipped(selection_env):
+    """A malformed/hand-edited PERF.json row with per_window_ms but a
+    missing or zero value key must not crash the selector or select a
+    degenerate K/chunk (ADVICE r3): such rows are skipped, and the
+    surviving fastest row is clamped to a positive int."""
+    selection_env("cpu", "cpu", window=[{
+        "edge_bucket": 8192,
+        "k_sweep": [
+            {"per_window_ms": 0.5},                       # no k_bucket
+            {"k_bucket": 0, "per_window_ms": 0.7},        # zero
+            {"k_bucket": None, "per_window_ms": 0.9},     # null
+            {"k_bucket": 64, "per_window_ms": 5.0},
+        ],
+        "chunk_sweep": [
+            {"per_window_ms": 0.1},                       # no value key
+            {"windows_per_dispatch": 0, "per_window_ms": 0.2},
+        ]}])
+    assert triangles._tuned_kb(8192) == 64
+    # every chunk row malformed -> the class default stands
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+    assert (triangles._tuned_chunk(8192)
+            == TriangleWindowKernel.MAX_STREAM_WINDOWS)
+
+
+HOST_WIN = [{"edge_bucket": 8192, "parity": True,
+             "host_edges_per_s": 2_000_000,
+             "device_edges_per_s": 800_000},
+            {"edge_bucket": 32768, "parity": True,
+             "host_edges_per_s": 1_500_000,
+             "device_edges_per_s": 900_000}]
+
+
+def test_stream_impl_flips_to_host_on_winning_cpu_rows(selection_env):
+    selection_env("cpu", "cpu", host_stream=HOST_WIN)
+    assert triangles._resolve_stream_impl() == "host"
+
+
+def test_stream_impl_stays_device_on_chip(selection_env):
+    # the host tier NEVER applies on a TPU backend, whatever the file
+    selection_env("tpu", "tpu", host_stream=HOST_WIN)
+    assert triangles._resolve_stream_impl() == "device"
+
+
+@pytest.mark.parametrize("rows", [
+    [],                                               # unmeasured
+    [dict(HOST_WIN[0], parity=False)],                # parity failure
+    [dict(HOST_WIN[0], host_edges_per_s=810_000)],    # < 5% win
+    HOST_WIN + [dict(HOST_WIN[1], edge_bucket=65536,  # loses at one eb
+                     host_edges_per_s=100_000)],
+])
+def test_stream_impl_needs_a_clean_win_everywhere(selection_env, rows):
+    selection_env("cpu", "cpu", host_stream=rows)
+    assert triangles._resolve_stream_impl() == "device"
+
+
+def test_stream_impl_ignores_tpu_labeled_file_on_cpu(selection_env):
+    selection_env("tpu", "cpu", host_stream=HOST_WIN)
+    assert triangles._resolve_stream_impl() == "device"

@@ -96,7 +96,7 @@ def test_cpu_backend_selects_binary_search_intersect():
     PERF.md `intersect`); the resolvers must pick it — and it must
     agree with the broadcast compare on the sorted-row contract the
     single-chip builder guarantees (build_window_counter sorts via
-    dedupe_pairs + CSR positions)."""
+    dedupe_and_positions)."""
     import jax
     import jax.numpy as jnp
 
@@ -438,3 +438,92 @@ def test_warm_chunks_precompiles_every_stream_bucket():
         jax.config.update("jax_log_compiles", False)
         logging.getLogger("jax").removeHandler(counter)
     assert not events, events
+
+
+# ----------------------------------------------------------------------
+# host (numpy) streaming tier: ops/host_triangles.py
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("seed", range(5))
+def test_host_window_count_vs_brute_force(seed):
+    from gelly_streaming_tpu.ops import host_triangles
+
+    rng = np.random.default_rng(100 + seed)
+    n, e = 30, 120
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)   # includes self-loops + duplicates
+    assert host_triangles.window_count(src, dst) == _brute_force(
+        src, dst, n)
+
+
+def test_host_count_stream_matches_device_kernel():
+    """Same window boundaries, same exact counts as
+    TriangleWindowKernel._count_stream_device on a skewed stream with
+    duplicates — the parity contract `host_stream` selection rows
+    assert before the tier can ever win."""
+    from gelly_streaming_tpu.ops import host_triangles
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    rng = np.random.default_rng(7)
+    eb, vb, num_w = 512, 256, 5
+    # zipf-ish skew so hubs stress the orientation + wedge enumeration
+    src = (rng.zipf(1.3, num_w * eb) % vb).astype(np.int32)
+    dst = (rng.zipf(1.3, num_w * eb) % vb).astype(np.int32)
+    kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    dev = kern._count_stream_device(src, dst)
+    host = host_triangles.count_stream(src, dst, eb)
+    assert host == dev
+    assert sum(host) > 0
+    # count_windows form on ragged windows
+    wins = [(src[:300], dst[:300]), (src[300:900], dst[300:900])]
+    assert (host_triangles.count_windows(wins)
+            == [host_triangles.window_count(*w) for w in wins])
+
+
+def test_host_window_count_wedge_chunking():
+    """The wedge-slice cap only bounds memory, never changes counts."""
+    from gelly_streaming_tpu.ops import host_triangles
+
+    rng = np.random.default_rng(13)
+    n, e = 200, 3000
+    src = rng.integers(0, n, e)
+    dst = rng.integers(0, n, e)
+    want = host_triangles.window_count(src, dst)
+    orig = host_triangles._WEDGE_CHUNK
+    try:
+        host_triangles._WEDGE_CHUNK = 64   # force many slices
+        assert host_triangles.window_count(src, dst) == want
+    finally:
+        host_triangles._WEDGE_CHUNK = orig
+
+
+def test_host_tier_selected_end_to_end(tmp_path, monkeypatch):
+    """With committed winning cpu rows, TriangleWindowKernel routes
+    count_stream/count_windows through the numpy tier (and warms
+    nothing)."""
+    import json
+
+    monkeypatch.setattr(tri_ops, "_PERF_PATH",
+                        str(tmp_path / "PERF.json"))
+    monkeypatch.setattr(tri_ops, "_STREAM_IMPL", None)
+    (tmp_path / "PERF.json").write_text(json.dumps({
+        "backend": "cpu",
+        "host_stream": [{"edge_bucket": 8192, "parity": True,
+                         "host_edges_per_s": 2_000_000,
+                         "device_edges_per_s": 800_000}]}))
+    try:
+        kern = tri_ops.TriangleWindowKernel(edge_bucket=512,
+                                            vertex_bucket=256)
+        rng = np.random.default_rng(3)
+        src = rng.integers(0, 256, 1024).astype(np.int32)
+        dst = rng.integers(0, 256, 1024).astype(np.int32)
+        got = kern.count_stream(src, dst)
+        # the selected tier compiled nothing
+        assert not kern._stream_execs
+        assert got == kern._count_stream_device(src, dst)
+        execs_before = dict(kern._stream_execs)
+        kern.warm_chunks()   # must be a no-op, not a compile storm
+        assert kern._stream_execs == execs_before
+    finally:
+        monkeypatch.undo()
+        tri_ops._STREAM_IMPL = None

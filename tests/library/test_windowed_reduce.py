@@ -1,0 +1,164 @@
+"""Columnar windowed-reduce engine (ops/windowed_reduce.py) — the
+stream-rate form of reduceOnEdges/foldNeighbors (BASELINE.json config
+#2; reference hot loop GraphWindowStream.java:101-121).
+
+Parity is pinned three ways: against the record-level runtime on the
+reference's golden TestSlice graph (same numbers the reference's own
+TestSlice.java:81-121 asserts), against a faithful numpy per-window
+fold on a 1M-edge fuzz stream, and across the monoid/associative-fn
+tiers.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu import (EdgeDirection, EdgesReduce,
+                                 SimpleEdgeStream, Time)
+from gelly_streaming_tpu.ops import segment as seg_ops
+from gelly_streaming_tpu.ops.windowed_reduce import (WindowedEdgeReduce,
+                                                     numpy_reference)
+
+from ..conftest import long_long_edges, run_and_sort
+
+FOLD_EXPECTED = {  # reference TestSlice.java:81-121
+    "out": {1: 25, 2: 23, 3: 69, 4: 45, 5: 51},
+    "in": {1: 51, 2: 12, 3: 36, 4: 34, 5: 80},
+    "all": {1: 76, 2: 35, 3: 105, 4: 79, 5: 131},
+}
+
+
+@pytest.mark.parametrize("direction", ["out", "in", "all"])
+def test_columnar_matches_golden_slice(direction):
+    """The columnar engine reproduces the reference's TestSlice sums
+    exactly (single window covering the whole 7-edge graph)."""
+    edges = long_long_edges()
+    src = np.array([e.source for e in edges])
+    dst = np.array([e.target for e in edges])
+    val = np.array([e.value for e in edges])
+    uniq, (s_d, d_d) = seg_ops.intern(src, dst)
+    eng = WindowedEdgeReduce(vertex_bucket=len(uniq), edge_bucket=8,
+                             name="sum", direction=direction)
+    (cells, counts), = eng.process_stream(s_d, d_d, val)
+    got = {int(uniq[slot]): int(cells[slot])
+           for slot in np.nonzero(counts)[0]}
+    assert got == FOLD_EXPECTED[direction]
+
+
+@pytest.mark.parametrize("direction,enum_dir", [
+    ("out", EdgeDirection.OUT), ("in", EdgeDirection.IN),
+    ("all", EdgeDirection.ALL)])
+def test_columnar_matches_record_level_path(env, direction, enum_dir):
+    """Same windows through the record-level runtime
+    (slice().reduce_on_edges with a host UDF — exact reference
+    semantics) and the columnar engine: identical per-vertex sums."""
+    edges = long_long_edges()
+    out = SimpleEdgeStream(env.from_collection(edges), env).slice(
+        Time.seconds(1), enum_dir).reduce_on_edges(
+        EdgesReduce(lambda a, b: a + b))
+    record_level = run_and_sort(env, out)
+
+    src = np.array([e.source for e in edges])
+    dst = np.array([e.target for e in edges])
+    val = np.array([e.value for e in edges])
+    uniq, (s_d, d_d) = seg_ops.intern(src, dst)
+    eng = WindowedEdgeReduce(vertex_bucket=len(uniq), edge_bucket=8,
+                             name="sum", direction=direction)
+    (cells, counts), = eng.process_stream(s_d, d_d, val)
+    columnar = sorted("%d,%d" % (uniq[slot], cells[slot])
+                      for slot in np.nonzero(counts)[0])
+    assert columnar == record_level
+
+
+@pytest.mark.parametrize("direction", ["out", "in", "all"])
+@pytest.mark.parametrize("name", ["sum", "min", "max"])
+def test_columnar_fuzz_vs_numpy_fold(direction, name):
+    """Multi-window fuzz (ragged tail, duplicate edges, skew) against
+    the faithful per-window numpy fold."""
+    rng = np.random.default_rng(41)
+    n, nv, eb = 10_000, 700, 1024
+    src = (rng.zipf(1.4, n) % nv).astype(np.int64)
+    dst = rng.integers(0, nv, n)
+    val = rng.integers(1, 1000, n).astype(np.int32)
+    eng = WindowedEdgeReduce(vertex_bucket=nv, edge_bucket=eb,
+                             name=name, direction=direction)
+    got = eng.process_stream(src, dst, val)
+    want = numpy_reference(src, dst, val, eb, direction, name)
+    assert len(got) == len(want) == -(-n // eb)
+    for (gc, gn), (wc, wn) in zip(got, want):
+        np.testing.assert_array_equal(gn[:nv], wn)
+        occ = wn > 0
+        np.testing.assert_array_equal(gc[:nv][occ], wc[occ])
+
+
+@pytest.mark.slow
+def test_columnar_million_edge_fuzz():
+    """VERDICT r3 item 3's fuzz bar: 1M edges through the engine at the
+    bench window size, exact parity with the numpy fold."""
+    rng = np.random.default_rng(43)
+    n, nv, eb = 1 << 20, 1 << 14, 8192
+    src = (rng.zipf(1.3, n) % nv).astype(np.int64)
+    dst = rng.integers(0, nv, n)
+    val = rng.integers(1, 100, n).astype(np.int64)
+    eng = WindowedEdgeReduce(vertex_bucket=nv, edge_bucket=eb,
+                             name="sum", direction="out")
+    got = eng.process_stream(src, dst, val)
+    want = numpy_reference(src, dst, val, eb, "out", "sum")
+    assert len(got) == len(want) == n // eb
+    for (gc, gn), (wc, wn) in zip(got, want):
+        np.testing.assert_array_equal(gn[:nv], wn)
+        np.testing.assert_array_equal(gc[:nv], wc)
+
+
+def test_associative_fn_tier_matches_monoid():
+    """fn=jnp.minimum through the flagged associative scan equals
+    name='min' through the segment kernels — and a non-monoid
+    associative fn (gcd) equals a direct per-cell fold."""
+    import math
+
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(47)
+    n, nv, eb = 600, 40, 128
+    src = rng.integers(0, nv, n)
+    dst = rng.integers(0, nv, n)
+    val = rng.integers(1, 10_000, n).astype(np.int32)
+
+    m = WindowedEdgeReduce(nv, eb, name="min").process_stream(
+        src, dst, val)
+    f = WindowedEdgeReduce(nv, eb, fn=jnp.minimum).process_stream(
+        src, dst, val)
+    for (mc, mn), (fc, fnn) in zip(m, f):
+        np.testing.assert_array_equal(mn, fnn)
+        occ = mn > 0
+        np.testing.assert_array_equal(mc[occ], fc[occ])
+
+    g = WindowedEdgeReduce(nv, eb, fn=jnp.gcd).process_stream(
+        src, dst, val)
+    for w, (gc, gn) in enumerate(g):
+        s, v = src[w * eb:(w + 1) * eb], val[w * eb:(w + 1) * eb]
+        for vtx in range(nv):
+            mask = s == vtx
+            assert gn[vtx] == mask.sum()
+            if mask.any():
+                acc = None
+                for x in v[mask].tolist():
+                    acc = x if acc is None else math.gcd(acc, x)
+                assert gc[vtx] == acc
+
+
+def test_window_chunking_boundaries():
+    """Streams longer than one dispatch chunk (MAX_STREAM_WINDOWS)
+    split without losing or shifting windows."""
+    rng = np.random.default_rng(53)
+    nv, eb = 64, 32
+    n = eb * 70 + 11   # > one 64-window chunk, ragged tail
+    src = rng.integers(0, nv, n)
+    dst = rng.integers(0, nv, n)
+    val = rng.integers(1, 50, n).astype(np.int32)
+    eng = WindowedEdgeReduce(nv, eb, name="sum")
+    got = eng.process_stream(src, dst, val)
+    want = numpy_reference(src, dst, val, eb)
+    assert len(got) == len(want) == 71
+    for (gc, gn), (wc, wn) in zip(got, want):
+        np.testing.assert_array_equal(gc[:nv], wc)
+        np.testing.assert_array_equal(gn[:nv], wn)

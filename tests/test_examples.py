@@ -113,3 +113,15 @@ def test_centralized_weighted_matching_on_movielens_file():
     pairs = re.findall(r"ADD (\d+),(\d+),\d+", out)
     assert pairs, "no matched edges printed"
     assert all(int(b) > 1_000_000 > int(a) for a, b in pairs)
+
+
+def test_measurements_cli_reduce(edge_file):
+    """BASELINE config #2's measured leg (columnar reduceOnEdges
+    sum-of-weights) runs through the CLI surface."""
+    r = _run(["examples/measurements.py", "reduce", edge_file, "8"])
+    assert r.returncode == 0, r.stderr[-500:]
+    import json
+
+    row = json.loads(r.stdout.strip().splitlines()[-1])
+    assert row["workload"].startswith("reduce_on_edges")
+    assert row["edges"] == 6 and row["windows"] >= 1

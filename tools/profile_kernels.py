@@ -121,7 +121,12 @@ def _count_overflow_recounts(kern, src, dst) -> int:
 
     kern.count = counting
     try:
-        kern.count_stream(src, dst)
+        # the DEVICE path explicitly: on a CPU backend with committed
+        # winning host_stream rows, count_stream routes to the numpy
+        # tier, which would make every K/chunk sweep row time the same
+        # K-independent host code (the committed-PERF feedback the
+        # sweep's anchor comments guard against)
+        kern._count_stream_device(src, dst)
     finally:
         kern.count = orig
     return overflows[0]
@@ -177,7 +182,7 @@ def section_window(results: dict) -> None:
             # undersized K pays (and warms every program it needs),
             # then the clean timing runs uninstrumented
             overflow_count = _count_overflow_recounts(kern, src, dst)
-            t = _timeit(lambda: kern.count_stream(src, dst),
+            t = _timeit(lambda: kern._count_stream_device(src, dst),
                         reps=3, warmup=0)
             row["k_sweep"].append({
                 "k_bucket": kern.kb,
@@ -212,8 +217,8 @@ def section_window(results: dict) -> None:
         row["chunk_sweep"] = []
         for cs in (32, 64, 128):
             kern.MAX_STREAM_WINDOWS = cs
-            kern.count_stream(csrc, cdst)   # warm this chunk shape
-            t = _timeit(lambda: kern.count_stream(csrc, cdst),
+            kern._count_stream_device(csrc, cdst)  # warm this chunk shape
+            t = _timeit(lambda: kern._count_stream_device(csrc, cdst),
                         reps=3, warmup=0)
             row["chunk_sweep"].append({
                 "windows_per_dispatch": cs,
@@ -348,6 +353,257 @@ def section_dense(results: dict) -> None:
     results["dense"] = out
 
 
+def _cost_rows(compiled):
+    """(flops, bytes_accessed) from XLA's cost model for an AOT-compiled
+    executable; (None, None) when the backend doesn't report them."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return ca.get("flops"), ca.get("bytes accessed")
+    except Exception:
+        return None, None
+
+
+def _roofline_row(name, compiled, args, extra=None):
+    """Time one AOT executable + place it on the v5e roofline: achieved
+    GFLOP/s vs the 197 TFLOP/s bf16 MXU peak and achieved GB/s vs the
+    819 GB/s HBM peak (XLA's own flops / bytes-accessed cost model; on
+    a CPU backend the fractions are labeled by the section's backend
+    key and are structure checks, not chip numbers)."""
+    import jax
+
+    t = _timeit(lambda: jax.tree_util.tree_map(
+        lambda x: x.block_until_ready(), compiled(*args)))
+    flops, bts = _cost_rows(compiled)
+    row = {"program": name, "ms": round(t * 1e3, 3)}
+    if flops:
+        row["gflops_achieved"] = round(flops / t / 1e9, 2)
+        row["mfu_vs_bf16_peak"] = round(
+            flops / t / (PEAK_BF16_TFLOPS * 1e12), 5)
+    if bts:
+        row["gbps_achieved"] = round(bts / t / 1e9, 2)
+        row["hbm_frac_of_peak"] = round(bts / t / (PEAK_HBM_GBPS * 1e9), 5)
+    if flops and bts:
+        # which peak the program sits closer to at this timing
+        row["bound"] = ("compute" if row["mfu_vs_bf16_peak"]
+                        >= row["hbm_frac_of_peak"] else "memory")
+        row["arith_intensity_flops_per_byte"] = round(flops / bts, 2)
+    if extra:
+        row.update(extra)
+    return row
+
+
+def section_roofline(results: dict) -> None:
+    """MFU / roofline placement of every hot program (VERDICT r3 item
+    1: 'achieved GOP/s vs 197 TFLOP/s bf16 and achieved GB/s vs 819
+    GB/s per kernel'). FLOP and byte counts come from XLA's compiled
+    cost model (not hand math), times from warmed dispatches of the
+    production configurations."""
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops.triangles import (TriangleWindowKernel,
+                                                   _dense_row_counts,
+                                                   intersect_local,
+                                                   intersect_local_bsearch)
+
+    rows = []
+    # --- the streaming window program at both bench buckets, exactly
+    # as the bench dispatches it (tuned K, 64-window chunk)
+    for eb in (8_192, 32_768):
+        vb = 2 * eb
+        num_w = 64
+        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+        src, dst = _stream(num_w * eb, vb)
+        from gelly_streaming_tpu.ops import segment as seg_ops
+
+        _, s, d, valid = seg_ops.window_stack(src, dst, kern.eb,
+                                              sentinel=kern.vb)
+        ex = kern._stream_exec(num_w)
+        args = (jnp.asarray(s[:num_w]), jnp.asarray(d[:num_w]),
+                jnp.asarray(valid[:num_w]))
+        rows.append(_roofline_row(
+            "window_stream_eb%d" % eb, ex, args,
+            {"k_bucket": kern.kb, "windows": num_w,
+             "edges_per_s": None}))
+        # fill the throughput key from the measured ms
+        rows[-1]["edges_per_s"] = round(
+            num_w * eb / (rows[-1]["ms"] / 1e3))
+
+    # --- the two intersect lowerings at the profile shape
+    ep, k, vbi = 16_384, 256, 1 << 16
+    rng = np.random.default_rng(3)
+    fill = rng.integers(0, vbi, size=(vbi + 1, k), dtype=np.int32)
+    fill.sort(axis=1)
+    keep = np.arange(k) < k // 4
+    nbr = jnp.asarray(np.where(keep[None, :], fill, vbi).astype(np.int32))
+    ea = jnp.asarray(rng.integers(0, vbi, size=ep, dtype=np.int32))
+    eb_ = jnp.asarray(rng.integers(0, vbi, size=ep, dtype=np.int32))
+    em = jnp.ones(ep, bool)
+    for name, fn in (("intersect_compare", intersect_local),
+                     ("intersect_bsearch", intersect_local_bsearch)):
+        ex = jax.jit(fn).lower(nbr, ea, eb_, em).compile()
+        rows.append(_roofline_row(name, ex, (nbr, ea, eb_, em),
+                                  {"ep": ep, "k": k}))
+
+    # --- the dense MXU path at its cutover size
+    v = 2048
+    e = 16 * v
+    rng = np.random.default_rng(5)
+    ds = jnp.asarray(rng.integers(0, v, size=e, dtype=np.int32))
+    dd = jnp.asarray(rng.integers(0, v, size=e, dtype=np.int32))
+    ex = jax.jit(_dense_row_counts, static_argnums=2).lower(
+        ds, dd, v).compile()
+    rows.append(_roofline_row("dense_matmul_v%d" % v, ex, (ds, dd),
+                              {"v": v}))
+    results["roofline"] = {
+        "peaks": {"bf16_tflops": PEAK_BF16_TFLOPS,
+                  "hbm_gbps": PEAK_HBM_GBPS, "hw": "tpu v5e (public)"},
+        "rows": rows,
+    }
+
+
+def section_trace(results: dict) -> None:
+    """Device trace of one production 64-window stream dispatch
+    (VERDICT r3 item 1: 'a device_trace of one 64-window chunk').
+    Captures a jax.profiler trace to logs/device_trace_<backend>/ and
+    commits the parsed per-op time breakdown (top ops by total device
+    time from the Chrome-trace export) into PERF.json — the raw xplane
+    stays in logs/ as the artifact."""
+    import glob
+    import gzip
+
+    import jax
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.ops import segment as seg_ops
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    eb, num_w = 32_768, 64
+    vb = 2 * eb
+    kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    src, dst = _stream(num_w * eb, vb)
+    _, s, d, valid = seg_ops.window_stack(src, dst, kern.eb,
+                                          sentinel=kern.vb)
+    ex = kern._stream_exec(num_w)
+    args = (jnp.asarray(s[:num_w]), jnp.asarray(d[:num_w]),
+            jnp.asarray(valid[:num_w]))
+    for _ in range(2):  # warm: compile + first-dispatch noise out
+        ex(*args)[0].block_until_ready()
+    tdir = os.path.join(REPO, "logs",
+                        "device_trace_%s" % jax.default_backend())
+    os.makedirs(tdir, exist_ok=True)
+    jax.profiler.start_trace(tdir)
+    t0 = time.perf_counter()
+    ex(*args)[0].block_until_ready()
+    wall = time.perf_counter() - t0
+    jax.profiler.stop_trace()
+
+    # parse the Chrome-trace export: total device time by op name
+    tops, err = [], None
+    try:
+        traces = sorted(glob.glob(os.path.join(
+            tdir, "plugins", "profile", "*", "*.trace.json.gz")),
+            key=os.path.getmtime)
+        with gzip.open(traces[-1], "rt") as f:
+            events = json.load(f).get("traceEvents", [])
+        by_name = {}
+        for ev in events:
+            if ev.get("ph") == "X" and ev.get("dur"):
+                rec = by_name.setdefault(ev["name"], [0.0, 0])
+                rec[0] += ev["dur"] / 1e3  # us -> ms
+                rec[1] += 1
+        tops = [{"op": n, "total_ms": round(ms, 3), "calls": c}
+                for n, (ms, c) in sorted(by_name.items(),
+                                         key=lambda kv: -kv[1][0])[:15]]
+    except Exception as e:  # trace format drift must not sink the run
+        err = "trace parse failed: %r" % e
+    results["trace"] = {
+        "edge_bucket": eb, "windows": num_w, "k_bucket": kern.kb,
+        "dispatch_wall_ms": round(wall * 1e3, 3),
+        "trace_dir": os.path.relpath(tdir, REPO),
+        "top_ops": tops,
+        **({"parse_error": err} if err else {}),
+    }
+
+
+def section_host_stream(results: dict) -> None:
+    """Vectorized numpy window tier vs the device (XLA) stream kernel
+    on THIS backend — the committed evidence `_resolve_stream_impl`
+    reads. On a CPU backend both forms run the same single core, so
+    the comparison is apples-to-apples; on a chip the device rows
+    should win outright (and the selection only ever applies on CPU
+    backends regardless)."""
+    import jax
+
+    from gelly_streaming_tpu.ops import host_triangles
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    sizes = (8_192, 32_768)
+    if jax.default_backend() == "cpu":
+        sizes = sizes + (65_536,)
+    out = []
+    for eb in sizes:
+        vb = 2 * eb
+        num_w = 32
+        src, dst = _stream(num_w * eb, vb)
+        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+        dev = kern._count_stream_device(src, dst)   # compile + warm
+        host = host_triangles.count_stream(src, dst, eb)
+        t_dev = _timeit(lambda: kern._count_stream_device(src, dst),
+                        reps=3, warmup=0)
+        t_host = _timeit(lambda: host_triangles.count_stream(
+            src, dst, eb), reps=3, warmup=0)
+        out.append({
+            "edge_bucket": eb, "windows": num_w,
+            "parity": host == dev,
+            "host_edges_per_s": round(num_w * eb / t_host),
+            "device_edges_per_s": round(num_w * eb / t_dev),
+            "host_vs_device": round(t_dev / t_host, 2),
+        })
+    results["host_stream"] = out
+
+
+def section_host_reduce(results: dict) -> None:
+    """Columnar windowed-reduce tiers (ops/windowed_reduce.py): device
+    segment kernels vs the vectorized host kernel, per monoid — the
+    committed evidence `_resolve_reduce_impl` reads (BASELINE config
+    #2's engine). Parity asserted row by row before timing."""
+    import numpy as np
+
+    from gelly_streaming_tpu.ops.windowed_reduce import WindowedEdgeReduce
+
+    rows = []
+    for name, eb in (("sum", 8_192), ("sum", 32_768), ("min", 8_192)):
+        nv = 2 * eb
+        num_w = 32
+        src, dst = _stream(num_w * eb, nv)
+        val = (1 + (src + 3 * dst) % 97).astype(np.int32)
+        eng = WindowedEdgeReduce(vertex_bucket=nv, edge_bucket=eb,
+                                 name=name, direction="out")
+        dev = eng._device_process_stream(src, dst, val)   # compile+warm
+        host = eng._host_process_stream(src, dst, val)
+        parity = all(
+            (np.array_equal(hc[:nv], dc[:nv])
+             if name == "sum" else
+             np.array_equal(hc[:nv][hn[:nv] > 0], dc[:nv][dn[:nv] > 0]))
+            and np.array_equal(hn[:nv], dn[:nv])
+            for (dc, dn), (hc, hn) in zip(dev, host))
+        t_dev = _timeit(lambda: eng._device_process_stream(
+            src, dst, val), reps=3, warmup=0)
+        t_host = _timeit(lambda: eng._host_process_stream(
+            src, dst, val), reps=3, warmup=0)
+        rows.append({
+            "name": name, "edge_bucket": eb, "windows": num_w,
+            "parity": parity,
+            "host_edges_per_s": round(num_w * eb / t_host),
+            "device_edges_per_s": round(num_w * eb / t_dev),
+            "host_vs_device": round(t_dev / t_host, 2),
+        })
+    results["host_reduce"] = rows
+
+
 def section_sharded(out_path: str) -> dict:
     """Run the sharded engines on the virtual 8-device CPU mesh in a
     subprocess (the backend pin must precede jax import)."""
@@ -423,6 +679,117 @@ tbl["owner_edges_per_s"] = big["owner_edges_per_s"]
 tbl["replicated_edges_per_s"] = big["replicated_edges_per_s"]
 tbl["counts_match"] = all(r["counts_match"] for r in tbl["rows"])
 out["sharded_table"] = tbl
+
+# ---- per-collective measured-vs-modeled breakdown (VERDICT r3 item 7):
+# each collective of build_sharded_window_counter microbenched ALONE at
+# the exact shapes of the 10M-scale config (eb=65536, vb=262144), next
+# to the analytic per-chip ICI bytes (window_collective_bytes) and the
+# v5e ICI time model. On this virtual CPU mesh the measured column is
+# shared-memory copy/dispatch time — a STRUCTURE validation; the same
+# rows become the real ICI validation the day a multi-chip mesh exists.
+import functools
+import jax
+from jax.sharding import PartitionSpec as P
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+from gelly_streaming_tpu.parallel.mesh import SHARD_AXIS
+from gelly_streaming_tpu.parallel.sharded import ici_time_model
+
+n = 8
+big_kern = ShardedTriangleWindowKernel(mesh, edge_bucket=65536,
+                                       vertex_bucket=262144)
+cvb, ckb, ccap = big_kern.vb, big_kern.kb, big_kern.cap
+kslice = ckb // n
+m = n * ccap
+ax = SHARD_AXIS
+rng = np.random.default_rng(11)
+
+
+def smap(body, in_specs, out_specs):
+    # check_vma off: these are timing microbenches of single collectives
+    # (all_gather's per-shard-identical output is not provably
+    # replicated to the vma checker without a no-op collective, which
+    # would pollute the very timing being measured)
+    try:
+        wrapped = functools.partial(
+            shard_map, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=False)(body)
+    except TypeError:   # older shard_map: no check_vma kwarg
+        wrapped = functools.partial(
+            shard_map, mesh=mesh, in_specs=in_specs,
+            out_specs=out_specs)(body)
+    return jax.jit(wrapped)
+
+
+def t_of(fn, *args):
+    import jax.numpy as jnp
+    jargs = tuple(jnp.asarray(a) for a in args)
+    r = fn(*jargs)
+    jax.block_until_ready(r)   # compile + warm
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*jargs))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) * 1e3
+
+
+def a2a(x):
+    return jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0,
+                              tiled=True)
+
+
+progs = {
+    "psum_degrees": (
+        smap(lambda x: jax.lax.psum(x[0], ax), (P(ax),), P()),
+        [rng.integers(0, 9, size=(n, cvb + 1), dtype=np.int32)]),
+    "all_to_all_pairs": (
+        smap(lambda x, y: (a2a(x), a2a(y)), (P(ax), P(ax)),
+             (P(ax), P(ax))),
+        [rng.integers(0, cvb, size=(n * n, ccap), dtype=np.int32),
+         rng.integers(0, cvb, size=(n * n, ccap), dtype=np.int32)]),
+    "pmax_table": (
+        smap(lambda x: jax.lax.pmax(x[0], ax), (P(ax),), P()),
+        [np.zeros((n, cvb + 1, ckb), np.int32)]),
+    "all_gather_row_ids": (
+        smap(lambda x: jax.lax.all_gather(x, ax), (P(ax),), P()),
+        [rng.integers(0, cvb, size=n * 2 * m, dtype=np.int32)]),
+    "all_to_all_row_slices": (
+        smap(a2a, (P(ax),), P(ax)),
+        [rng.integers(-1, cvb, size=(n * n, 2 * m, kslice),
+                      dtype=np.int32)]),
+    "psum_count_and_overflow": (
+        smap(lambda x: jax.lax.psum(x[0], ax), (P(ax),), P()),
+        [rng.integers(0, 9, size=(n, 3), dtype=np.int32)]),
+}
+from gelly_streaming_tpu.parallel.sharded import window_collective_bytes
+model_r = window_collective_bytes(n, cvb, ckb, ccap, "replicated")
+model_o = window_collective_bytes(n, cvb, ckb, ccap, "owner")
+model = dict(model_o); model.update(model_r)
+tmodel = ici_time_model(model)
+coll_rows = []
+for cname, (prog, args) in progs.items():
+    row = {
+        "collective": cname,
+        "modeled_ici_bytes_per_chip": round(model[cname]),
+        "modeled_ms_v5e_ici": round(tmodel[cname] * 1e3, 4),
+    }
+    try:   # one collective's lowering quirk must not sink the section
+        row["measured_ms_cpu_mesh"] = round(t_of(prog, *args), 3)
+    except Exception as exc:
+        row["error"] = repr(exc)[:300]
+    coll_rows.append(row)
+out["collectives"] = {
+    "config": {"n": n, "vb": cvb, "kb": ckb, "cap": ccap,
+               "edge_bucket": 65536},
+    "backend": "cpu-virtual-mesh",
+    "note": ("measured column is host shared-memory copy time on the "
+             "virtual mesh; modeled columns are the exact per-chip ICI "
+             "accounting to validate on real multi-chip hardware"),
+    "rows": coll_rows,
+}
 print(json.dumps(out))
 """ % REPO
     # PYTHONPATH is stripped so the baked sitecustomize can't dial the
@@ -444,6 +811,10 @@ SECTIONS = {
     "fused": section_fused,
     "dense": section_dense,
     "driver": section_driver,
+    "roofline": section_roofline,
+    "trace": section_trace,
+    "host_stream": section_host_stream,
+    "host_reduce": section_host_reduce,
 }
 
 

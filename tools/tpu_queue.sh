@@ -7,7 +7,7 @@
 set -u
 MAX_WAIT_S=${MAX_WAIT_S:-14400}
 POLL_S=${POLL_S:-180}
-RTAG=${RTAG:-r03}
+RTAG=${RTAG:-r04}
 cd /root/repo
 mkdir -p logs
 
@@ -28,10 +28,14 @@ echo "=== stage 1: bench.py (first number in hand, untuned K) ==="
 timeout 5400 python bench.py >"logs/bench_${RTAG}_stage1.log" 2>"logs/bench_${RTAG}_stage1.err"
 echo "bench rc=$? ; $(tail -1 "logs/bench_${RTAG}_stage1.log" 2>/dev/null)"
 
-echo "=== stage 2: profile_kernels (writes the chip k-sweep) ==="
-timeout 5400 python tools/profile_kernels.py >"logs/profile_${RTAG}.log" 2>"logs/profile_${RTAG}.err"
+echo "=== stage 2: profile_kernels (chip k-sweep + roofline + trace + sharded collectives) ==="
+timeout 7200 python tools/profile_kernels.py >"logs/profile_${RTAG}.log" 2>"logs/profile_${RTAG}.err"
 prof_rc=$?
 echo "profile rc=$prof_rc"
+# regenerate the human-readable evidence tables from PERF.json in the
+# same unattended window (no transcription step to lose)
+timeout 120 python tools/update_perf_md.py >>"logs/profile_${RTAG}.log" 2>&1
+echo "perf_md rc=$?"
 
 # gate on what stage 3 actually consumes: a chip-labeled k-sweep in
 # the COMMITTED PERF.json (a CPU-fallback profile writes .partial only
