@@ -44,7 +44,8 @@ from ..utils.interning import make_interner
 from ..utils.tracing import StepTimer
 
 
-def _build_snapshot_scan(vb: int, analytics: tuple):
+def _build_snapshot_scan(vb: int, analytics: tuple,
+                         deltas: bool = False):
     """One jitted lax.scan over a [W, eb] window stack, carrying
     (degrees, cc labels, double-cover labels) and emitting PER-WINDOW
     snapshots — the driver's batched single-chip fast path (sharded
@@ -52,7 +53,13 @@ def _build_snapshot_scan(vb: int, analytics: tuple):
     dispatch + one d2h per run_arrays call instead of one per analytic
     per window (dispatch latency through a tunneled chip ~0.2s
     dominates per-window economics). Cover layout matches the driver's
-    host state: (+) = v, (−) = vb + v, sentinel slot 2vb."""
+    host state: (+) = v, (−) = vb + v, sentinel slot 2vb.
+
+    With `deltas`, each analytic also emits a per-window changed-slot
+    bool mask over [:vb] (new state vs the scan carry — computed
+    on-device, so a consumer of the reference's improving streams
+    (SimpleEdgeStream.java:473-481) can reconstruct per-update records
+    from snapshot + mask without diffing full vectors on host)."""
     import jax
     import jax.numpy as jnp
 
@@ -69,10 +76,16 @@ def _build_snapshot_scan(vb: int, analytics: tuple):
         d = jnp.where(valid, dst, vb)
         outs = {}
         if want_deg:
-            deg = deg.at[s].add(1).at[d].add(1)  # slot vb absorbs pads
+            new_deg = deg.at[s].add(1).at[d].add(1)  # slot vb: pads
+            if deltas:
+                outs["deg_chg"] = new_deg[:vb] != deg[:vb]
+            deg = new_deg
             outs["deg"] = deg
         if want_cc:
-            labels = uf.cc_fixpoint(labels, s, d)
+            new_labels = uf.cc_fixpoint(labels, s, d)
+            if deltas:
+                outs["labels_chg"] = new_labels[:vb] != labels[:vb]
+            labels = new_labels
             outs["labels"] = labels
         if want_bip:
             sent2 = 2 * vb
@@ -82,7 +95,14 @@ def _build_snapshot_scan(vb: int, analytics: tuple):
             d2 = jnp.concatenate([
                 jnp.where(valid, d + vb, sent2),
                 jnp.where(valid, d, sent2)])
-            cover = uf.cc_fixpoint(cover, s2, d2)
+            new_cover = uf.cc_fixpoint(cover, s2, d2)
+            if deltas:
+                # the consumer-visible value is the odd flag, so the
+                # mask tracks IT, not raw cover labels
+                outs["cover_chg"] = (
+                    (new_cover[:vb] == new_cover[vb:2 * vb])
+                    != (cover[:vb] == cover[vb:2 * vb]))
+            cover = new_cover
             outs["cover"] = cover
         return (deg, labels, cover), outs
 
@@ -105,6 +125,15 @@ class WindowResult:
     cc_labels: Optional[np.ndarray] = None      # carried min-label slots
     bipartite_odd: Optional[np.ndarray] = None  # carried odd-cycle flag
     triangles: Optional[int] = None             # exact, this window only
+    # emit_deltas=True: per-window changed-slot streams — (slot ids,
+    # new values) for every slot whose value differs from the previous
+    # window's snapshot (the per-update improving-stream analog of
+    # SimpleEdgeStream.java:473-481; start states are all-zero degrees,
+    # identity labels, all-False odd). Slots are in the same dense slot
+    # space as the snapshot arrays.
+    delta_degrees: Optional[tuple] = None       # (int32 ids, int64 vals)
+    delta_cc: Optional[tuple] = None            # (int32 ids, int32 vals)
+    delta_bipartite: Optional[tuple] = None     # (int32 ids, bool vals)
 
 
 class StreamingAnalyticsDriver:
@@ -114,12 +143,14 @@ class StreamingAnalyticsDriver:
                  analytics: Sequence[str] = ANALYTICS,
                  vertex_bucket: int = 1 << 12,
                  edge_bucket: int = 1 << 12,
-                 mesh=None, tracing: bool = False):
+                 mesh=None, tracing: bool = False,
+                 emit_deltas: bool = False):
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
         self.window_ms = window_ms
         self.analytics = tuple(analytics)
+        self.emit_deltas = bool(emit_deltas)
         self.mesh = mesh
         self.timer = StepTimer() if tracing else None
         self.interner = make_interner(np.array([0]))
@@ -362,10 +393,16 @@ class StreamingAnalyticsDriver:
     # ------------------------------------------------------------------
     _SCAN_CHUNK = 64  # max windows per dispatch; W pads to buckets
 
+    def _scan_chunk(self) -> int:
+        """Windows per snapshot-scan dispatch: _SCAN_CHUNK, compile-
+        size-capped on the tunneled chip (a 2^21-edge stream program
+        wedged the remote compiler; ops/triangles._default_chunk)."""
+        return min(self._SCAN_CHUNK, tri_ops._default_chunk(self.eb))
+
     def _scan_fn(self, num_w: int):
         """Jitted snapshot scan for the current buckets, cached per
         (vb, eb, analytics, W-bucket) — O(log) programs total."""
-        wb = seg_ops.bucket_size(min(num_w, self._SCAN_CHUNK))
+        wb = seg_ops.bucket_size(min(num_w, self._scan_chunk()))
         key = (self.vb, self.eb, self.analytics, wb)
         if getattr(self, "_scan_cache_key", None) != key[:3]:
             self._scan_cache = {}
@@ -375,10 +412,11 @@ class StreamingAnalyticsDriver:
                 from ..parallel.sharded import make_sharded_snapshot_scan
 
                 self._scan_cache[wb] = make_sharded_snapshot_scan(
-                    self.mesh, self.vb, self.analytics)
+                    self.mesh, self.vb, self.analytics,
+                    deltas=self.emit_deltas)
             else:
                 self._scan_cache[wb] = _build_snapshot_scan(
-                    self.vb, self.analytics)
+                    self.vb, self.analytics, deltas=self.emit_deltas)
         return self._scan_cache[wb], wb
 
     def _run_batched(self, windows,
@@ -438,8 +476,9 @@ class StreamingAnalyticsDriver:
 
         results = []
         num_w = len(interned)
-        for at in range(0, num_w, self._SCAN_CHUNK):
-            chunk = interned[at:at + self._SCAN_CHUNK]
+        scan_chunk = self._scan_chunk()
+        for at in range(0, num_w, scan_chunk):
+            chunk = interned[at:at + scan_chunk]
             outs = {}
             if run_scan:
                 fn, wb = self._scan_fn(len(chunk))
@@ -466,12 +505,27 @@ class StreamingAnalyticsDriver:
                     snap = outs["deg"][i][:nv].astype(np.int64)
                     self._check_degree_width(snap)
                     res.degrees = snap
+                    if "deg_chg" in outs:
+                        idx = np.nonzero(
+                            outs["deg_chg"][i][:nv])[0].astype(np.int32)
+                        res.delta_degrees = (idx, snap[idx])
                 if "labels" in outs:
                     res.cc_labels = outs["labels"][i][:nv].copy()
+                    if "labels_chg" in outs:
+                        idx = np.nonzero(
+                            outs["labels_chg"][i][:nv])[0].astype(
+                                np.int32)
+                        res.delta_cc = (idx, res.cc_labels[idx])
                 if "cover" in outs:
                     plus = outs["cover"][i][:vb]
                     minus = outs["cover"][i][vb:2 * vb]
                     res.bipartite_odd = (plus == minus)[:nv]
+                    if "cover_chg" in outs:
+                        idx = np.nonzero(
+                            outs["cover_chg"][i][:nv])[0].astype(
+                                np.int32)
+                        res.delta_bipartite = (
+                            idx, res.bipartite_odd[idx])
                 if "triangles" in self.analytics:
                     # _batched_triangles (always active around this
                     # path when triangles are on) flushes these in one
@@ -517,7 +571,7 @@ class StreamingAnalyticsDriver:
             self.windows_done += len(chunk)
             self.edges_done += sum(
                 len(s) for _w, s, _d, _n in chunk)
-            if closes_partial and at + self._SCAN_CHUNK >= num_w:
+            if closes_partial and at + scan_chunk >= num_w:
                 # the short final window lives in this chunk: the flag
                 # joins this boundary's state (and its checkpoint),
                 # never an earlier one's
@@ -571,8 +625,60 @@ class StreamingAnalyticsDriver:
         # copy: WindowResult fields are snapshots, never live views
         return self._ext_ids[:nv].copy()
 
+    def _prev_snapshots(self) -> dict:
+        """Previous-window snapshot values for host-side delta diffing
+        on the per-window path (the batched path gets its masks from
+        the device scan instead). Single-chip reads the host mirrors;
+        sharded syncs the engine state (one extra d2h — the per-window
+        path already pays several per window)."""
+        if self._engine is not None:
+            st = self._engine.state_dict()
+            vb = st["vb"]
+            prev = {"deg": np.asarray(st["degree_state"])[:vb].astype(
+                np.int64),
+                "cc": np.asarray(st["labels"])[:vb]}
+            if "bip_labels" in st:
+                cov = np.asarray(st["bip_labels"])
+                prev["odd"] = cov[:vb] == cov[vb:2 * vb]
+            return prev
+        prev = {"deg": self._degrees, "cc": self._cc.copy()}
+        if len(self._bip):
+            _, _, odd = unionfind.decode_double_cover(
+                self._bip, len(self._bip) // 2)
+            prev["odd"] = odd
+        return prev
+
+    @staticmethod
+    def _host_delta(new: np.ndarray, prev: np.ndarray, init):
+        """(changed ids, new values) of `new` vs `prev` padded to its
+        length with the analytic's start value (0 degrees / identity
+        labels / False odd)."""
+        full = np.empty(len(new), new.dtype)
+        if init == "identity":
+            full[:] = np.arange(len(new))
+        else:
+            full[:] = init
+        n = min(len(prev), len(new))
+        full[:n] = prev[:n]
+        idx = np.nonzero(new != full)[0].astype(np.int32)
+        return idx, new[idx]
+
+    def _attach_host_deltas(self, res: WindowResult,
+                            prev: dict) -> None:
+        if res.degrees is not None:
+            res.delta_degrees = self._host_delta(
+                res.degrees, prev.get("deg", ()), 0)
+        if res.cc_labels is not None:
+            res.delta_cc = self._host_delta(
+                res.cc_labels, prev.get("cc", ()), "identity")
+        if res.bipartite_odd is not None:
+            res.delta_bipartite = self._host_delta(
+                np.asarray(res.bipartite_odd),
+                np.asarray(prev.get("odd", ()), bool), False)
+
     def _window(self, wstart: int, src: np.ndarray,
                 dst: np.ndarray) -> WindowResult:
+        prev = self._prev_snapshots() if self.emit_deltas else None
         with self._step("intern", 2 * len(src)):
             s = self.interner.intern_array(src)
             d = self.interner.intern_array(dst)
@@ -591,6 +697,8 @@ class StreamingAnalyticsDriver:
             else:
                 with self._step(name, len(src)):
                     self._run_one(name, s, d, nv, res)
+        if prev is not None:
+            self._attach_host_deltas(res, prev)
         self.windows_done += 1
         self.edges_done += len(src)
         if (self._ckpt_path

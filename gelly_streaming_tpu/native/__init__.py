@@ -61,6 +61,16 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
         ctypes.POINTER(ctypes.c_int64),
     ]
+    try:
+        lib.gs_triangle_count_stream.restype = ctypes.c_int64
+        lib.gs_triangle_count_stream.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+        ]
+    except AttributeError:
+        # a stale pre-triangle libgsnative.so: everything else still
+        # works; triangle_count_stream() reports unavailable
+        pass
     _lib = lib
     return _lib
 
@@ -132,6 +142,31 @@ def assign_windows(ts: np.ndarray, size_ms: int) -> np.ndarray:
         lib.gs_assign_windows(_i64ptr(ts), len(ts), size_ms, _i64ptr(out))
         return out
     return ts - np.mod(ts, size_ms)
+
+
+def triangles_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "gs_triangle_count_stream")
+
+
+def triangle_count_stream(src: np.ndarray, dst: np.ndarray,
+                          eb: int) -> Optional[np.ndarray]:
+    """Exact triangle counts of every tumbling `eb`-sized window of the
+    stream via the C++ compact-forward counter (ingest.cpp
+    gs_triangle_count_stream) — the native tier of
+    ops/triangles.count_stream. Returns None when the library (or the
+    symbol, for a stale build) is unavailable; callers fall back to the
+    numpy tier. Counting invariant and results are identical to the
+    numpy and device tiers (asserted in tests/library/test_triangles.py)."""
+    if not triangles_available():
+        return None
+    src = np.ascontiguousarray(src, np.int64)
+    dst = np.ascontiguousarray(dst, np.int64)
+    num_w = (len(src) + eb - 1) // eb
+    counts = np.empty(max(num_w, 1), np.int64)
+    w = _lib.gs_triangle_count_stream(_i64ptr(src), _i64ptr(dst),
+                                      len(src), eb, _i64ptr(counts))
+    return counts[:w]
 
 
 class NativeInterner:

@@ -9,6 +9,7 @@
 //
 // Build: make -C gelly_streaming_tpu/native   (produces libgsnative.so)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -123,6 +124,146 @@ void gs_interner_lookup(void* h, const int32_t* dense, int64_t n,
                         int64_t* out) {
     auto* interner = static_cast<GsInterner*>(h);
     for (int64_t i = 0; i < n; ++i) out[i] = interner->to_id[dense[i]];
+}
+
+// ---------------------------------------------------------------------
+// Exact window triangle count — the native tier of the streaming
+// counter (ops/triangles._resolve_stream_impl "native").
+//
+// Same counting invariant as the device kernel (ops/triangles.py
+// build_window_counter) and the numpy tier (ops/host_triangles.py):
+// drop self-loops, undirect + dedupe, orient each edge
+// low(deg, id) -> high(deg, id), count every triangle once — at its
+// min-rank edge, by two-pointer intersection of the endpoints' sorted
+// out-neighbor lists ("compact forward": per-source out-degree is
+// O(sqrt E) after orientation, so the scan is O(E^1.5) worst case with
+// cache-friendly constant factors a single-core numpy pipeline cannot
+// reach (no temporary wedge materialization, no log-factor probes).
+// ---------------------------------------------------------------------
+namespace {
+
+int64_t count_one_window(const int64_t* src, const int64_t* dst,
+                         int64_t n, std::vector<int64_t>& scratch_ids,
+                         std::vector<uint64_t>& keys,
+                         std::vector<int32_t>& deg,
+                         std::vector<int64_t>& starts) {
+    if (n <= 2) return 0;
+    // id space: ids that are already small non-negative ints (every
+    // interned stream; the bench's generated streams) index arrays
+    // directly — no compression pass. Arbitrary/huge ids fall back to
+    // sort-unique + binary-search compression.
+    int64_t max_id = -1;
+    bool direct = true;
+    for (int64_t i = 0; i < n; ++i) {
+        if (src[i] < 0 || dst[i] < 0) { direct = false; break; }
+        if (src[i] > max_id) max_id = src[i];
+        if (dst[i] > max_id) max_id = dst[i];
+    }
+    // direct indexing allocates O(max_id) scratch per call: only worth
+    // it when the id space is within a small factor of the edge count
+    if (max_id >= (int64_t(1) << 22) || max_id > 16 * n) direct = false;
+    uint64_t v;
+    keys.clear();
+    keys.reserve(n);
+    if (direct) {
+        v = static_cast<uint64_t>(max_id) + 1;
+        for (int64_t i = 0; i < n; ++i) {
+            if (src[i] == dst[i]) continue;  // self-loop
+            uint64_t a = static_cast<uint64_t>(src[i]);
+            uint64_t b = static_cast<uint64_t>(dst[i]);
+            if (a > b) std::swap(a, b);
+            keys.push_back(a * v + b);
+        }
+        if (keys.empty()) return 0;
+    } else {
+        // local dense ids: sort-unique of all endpoints
+        scratch_ids.clear();
+        scratch_ids.reserve(2 * n);
+        for (int64_t i = 0; i < n; ++i) {
+            if (src[i] == dst[i]) continue;  // self-loop
+            scratch_ids.push_back(src[i]);
+            scratch_ids.push_back(dst[i]);
+        }
+        if (scratch_ids.empty()) return 0;
+        std::sort(scratch_ids.begin(), scratch_ids.end());
+        scratch_ids.erase(
+            std::unique(scratch_ids.begin(), scratch_ids.end()),
+            scratch_ids.end());
+        v = scratch_ids.size();
+        auto dense = [&](int64_t id) -> uint64_t {
+            return static_cast<uint64_t>(
+                std::lower_bound(scratch_ids.begin(), scratch_ids.end(),
+                                 id)
+                - scratch_ids.begin());
+        };
+        for (int64_t i = 0; i < n; ++i) {
+            if (src[i] == dst[i]) continue;
+            uint64_t a = dense(src[i]), b = dense(dst[i]);
+            if (a > b) std::swap(a, b);
+            keys.push_back(a * v + b);
+        }
+    }
+    std::sort(keys.begin(), keys.end());
+    keys.erase(std::unique(keys.begin(), keys.end()), keys.end());
+    const int64_t e = static_cast<int64_t>(keys.size());
+
+    // degrees over the deduped undirected edges
+    deg.assign(v, 0);
+    for (int64_t i = 0; i < e; ++i) {
+        ++deg[keys[i] / v];
+        ++deg[keys[i] % v];
+    }
+
+    // orient by (degree, id) and re-sort by (a, b): out-adjacency
+    // lists come out sorted, ready for two-pointer intersection
+    for (int64_t i = 0; i < e; ++i) {
+        uint64_t lo = keys[i] / v, hi = keys[i] % v;
+        if (deg[lo] > deg[hi] || (deg[lo] == deg[hi] && lo > hi))
+            std::swap(lo, hi);
+        keys[i] = lo * v + hi;
+    }
+    std::sort(keys.begin(), keys.end());
+
+    // CSR starts of the oriented lists
+    starts.assign(v + 1, 0);
+    for (int64_t i = 0; i < e; ++i) ++starts[keys[i] / v + 1];
+    for (uint64_t u = 0; u < v; ++u) starts[u + 1] += starts[u];
+
+    // for each oriented edge (a, b): |N_out(a) ∩ N_out(b)|
+    int64_t count = 0;
+    for (int64_t i = 0; i < e; ++i) {
+        const uint64_t a = keys[i] / v, b = keys[i] % v;
+        int64_t pa = starts[a], ea = starts[a + 1];
+        int64_t pb = starts[b], eb2 = starts[b + 1];
+        while (pa < ea && pb < eb2) {
+            const uint64_t xa = keys[pa] % v, xb = keys[pb] % v;
+            if (xa == xb) { ++count; ++pa; ++pb; }
+            else if (xa < xb) ++pa;
+            else ++pb;
+        }
+    }
+    return count;
+}
+
+}  // namespace
+
+// counts[w] = exact triangle count of the w-th tumbling eb-sized
+// window of the stream (the trailing window may be shorter); returns
+// the number of windows written.
+int64_t gs_triangle_count_stream(const int64_t* src, const int64_t* dst,
+                                 int64_t n, int64_t eb,
+                                 int64_t* counts) {
+    std::vector<int64_t> ids;
+    std::vector<uint64_t> keys;
+    std::vector<int32_t> deg;
+    std::vector<int64_t> starts;
+    int64_t w = 0;
+    for (int64_t at = 0; at < n; at += eb, ++w) {
+        const int64_t len = (n - at < eb) ? (n - at) : eb;
+        counts[w] = count_one_window(src + at, dst + at, len, ids, keys,
+                                     deg, starts);
+    }
+    return w;
 }
 
 }  // extern "C"

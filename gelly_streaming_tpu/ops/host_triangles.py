@@ -34,13 +34,22 @@ _WEDGE_CHUNK = 4 << 20
 
 
 def window_count(src: np.ndarray, dst: np.ndarray) -> int:
-    """Exact triangle count of one window (any integer vertex ids)."""
+    """Exact triangle count of one window (any integer vertex ids:
+    dense non-negative ids index directly; negative or huge ids — where
+    v² would overflow the packed int64 keys — are first compressed to
+    dense slots, the same split the native tier makes)."""
     s = np.asarray(src, np.int64)
     d = np.asarray(dst, np.int64)
     keep = s != d
     s, d = s[keep], d[keep]
     if len(s) == 0:
         return 0
+    if int(s.min()) < 0 or int(d.min()) < 0 \
+            or int(max(s.max(), d.max())) >= (1 << 31):
+        uniq, inv = np.unique(np.concatenate([s, d]),
+                              return_inverse=True)
+        s, d = inv[:len(s)].astype(np.int64), inv[len(s):].astype(
+            np.int64)
     v = int(max(s.max(), d.max())) + 1
 
     # undirect + dedupe on packed keys

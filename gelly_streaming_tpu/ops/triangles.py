@@ -173,9 +173,23 @@ def _load_matching_perf(required_backend: str = None):
         backend = _jax.default_backend()
         if required_backend is not None and backend != required_backend:
             return None
-        with open(_PERF_PATH) as f:
-            perf = json.load(f)
-        if perf.get("backend") != backend:
+        perf = None
+        # PERF.json carries the most recent profile run; when that run
+        # was on ANOTHER backend (e.g. the file is chip-labeled and
+        # this is the CPU fallback), the per-backend archive
+        # PERF_<backend>.json keeps this backend's committed rows alive
+        # — selections must survive the other backend being profiled.
+        for path in (_PERF_PATH,
+                     _PERF_PATH[:-5] + "_%s.json" % backend):
+            try:
+                with open(path) as f:
+                    cand = json.load(f)
+            except Exception:
+                continue
+            if cand.get("backend") == backend:
+                perf = cand
+                break
+        if perf is None:
             return None
         # drop failed-section stubs ({"error": ...}) and *_error
         # markers the profiler may record: consumers see only real
@@ -387,15 +401,20 @@ _STREAM_IMPL = None   # "device" | "host", resolved once per process
 
 
 def _resolve_stream_impl() -> str:
-    """Streaming-counter tier: the device (XLA) kernel by default; the
-    vectorized numpy kernel (ops/host_triangles.py) only when (a) this
-    process runs a CPU backend — on chip the device kernel always
-    stands — and (b) committed backend-matched measurements (PERF.json
-    `host_stream` section, tools/profile_kernels.py) show the host
-    form at parity and ≥5% faster at EVERY measured bucket. Same
-    measured-default policy as the dense/Pallas/intersect selections:
-    the CPU fallback floor is allowed to pick the implementation that
-    actually wins on a CPU, but only on committed evidence."""
+    """Streaming-counter tier: the device (XLA) kernel by default; a
+    HOST tier only when (a) this process runs a CPU backend — on chip
+    the device kernel always stands — and (b) committed backend-matched
+    measurements (PERF.json `host_stream` section,
+    tools/profile_kernels.py) show that host form at parity and ≥5%
+    faster at EVERY measured bucket. Two host tiers compete under the
+    same rule: "native" (the C++ compact-forward counter,
+    native/ingest.cpp — needs `native_parity`/`native_edges_per_s`
+    rows AND a loadable library) beats "host" (the vectorized numpy
+    kernel, ops/host_triangles.py) when its committed rows also clear
+    the numpy tier by ≥5%. Same measured-default policy as the
+    dense/Pallas/intersect selections: the CPU fallback floor picks
+    the implementation that actually wins on a CPU, but only on
+    committed evidence."""
     global _STREAM_IMPL
     if _STREAM_IMPL is not None:
         return _STREAM_IMPL
@@ -412,6 +431,17 @@ def _resolve_stream_impl() -> str:
                             >= 1.05 * (r.get("device_edges_per_s") or 0)
                             for r in rows)):
                 impl = "host"
+            if (isinstance(rows, list) and rows
+                    and all(r.get("native_parity") is True
+                            and (r.get("native_edges_per_s") or 0)
+                            >= 1.05 * max(
+                                r.get("device_edges_per_s") or 0,
+                                r.get("host_edges_per_s") or 0)
+                            for r in rows)):
+                from .. import native as _native
+
+                if _native.triangles_available():
+                    impl = "native"
     except Exception:
         pass
     _STREAM_IMPL = impl
@@ -470,22 +500,42 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
 _TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch
 
 
+def _default_chunk(eb: int) -> int:
+    """Unmeasured windows-per-dispatch default. On a TPU backend the
+    chunk is capped so the stream program stays ≤ 2^19 edges: both
+    programs the round-4 chip window compiled cleanly sit exactly
+    there (64×8192, 16×32768), while the 64×32768 = 2^21 program
+    wedged the tunnel's remote compiler >25 min twice
+    (logs/bench_r04_stage1.err; round 2 saw the same at 131072-edge
+    windows). Off-chip the sweep is flat, so the class default
+    stands."""
+    try:
+        import jax as _jax
+
+        if _jax.default_backend() == "tpu":
+            return max(1, min(TriangleWindowKernel.MAX_STREAM_WINDOWS,
+                              (1 << 19) // max(eb, 1)))
+    except Exception:
+        pass
+    return TriangleWindowKernel.MAX_STREAM_WINDOWS
+
+
 def _tuned_chunk(eb: int) -> int:
     """Windows per count_stream dispatch: the fastest measured
     chunk_sweep row for this bucket on this backend (committed
     PERF.json `window` rows; the sweep runs at the same fastest-row K
     that _tuned_kb selects, so the chunk is tuned for the K production
-    actually runs). Fallback: the class default. On CPU the committed
-    sweep is flat within a few percent at every bucket — dispatch is
-    ~free off-chip, so the pick there is load-noise-driven and
-    harmless; the selector exists for the tunneled chip, where each
-    dispatch costs ~0.2s and the chunk size sets how that latency
-    amortizes."""
+    actually runs). Fallback: _default_chunk (compile-size-capped on
+    the tunneled chip). On CPU the committed sweep is flat within a
+    few percent at every bucket — dispatch is ~free off-chip, so the
+    pick there is load-noise-driven and harmless; the selector exists
+    for the tunneled chip, where each dispatch costs ~0.2s and the
+    chunk size sets how that latency amortizes."""
     if eb in _TUNED_CHUNK:
         return _TUNED_CHUNK[eb]
     _TUNED_CHUNK[eb] = _fastest_sweep_row(
         eb, "chunk_sweep", "windows_per_dispatch",
-        default=TriangleWindowKernel.MAX_STREAM_WINDOWS)
+        default=_default_chunk(eb))
     return _TUNED_CHUNK[eb]
 
 
@@ -611,9 +661,26 @@ class TriangleWindowKernel:
         rare exact overflow recount. The window axis of a ragged final
         chunk pads to a power-of-two bucket (all-invalid rows), so
         varying stream lengths reuse O(log MAX_STREAM_WINDOWS) compiled
-        programs instead of one per distinct tail length."""
+        programs instead of one per distinct tail length.
+
+        Dispatch is PIPELINED depth 2: jax enqueues asynchronously, so
+        the host pads + enqueues chunk i+1 while the device runs chunk
+        i, and only then materializes chunk i's [W]-scalar outputs —
+        overlap instead of pad→run→block→pad serialization (the d2h of
+        counts is tiny; the win is hiding host prep + dispatch latency
+        behind device compute)."""
         num_w = s.shape[0]
         counts: list = []
+        pending = None  # (at, n, c_dev, o_dev)
+
+        def materialize(at, n, c_dev, o_dev):
+            # np.array (not asarray): device outputs can be read-only
+            c, o = np.array(c_dev)[:n], np.array(o_dev)[:n]
+            for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
+                ws, wd = get_window(at + int(w))
+                c[w] = self.count(ws, wd, min_k=self.kb)
+            counts.extend(int(x) for x in c)
+
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
             sc, dc, vc, n = seg_ops.pad_window_chunk(
@@ -621,12 +688,11 @@ class TriangleWindowKernel:
                 self.vb)
             c, o = self._stream_exec(sc.shape[0])(
                 jnp.asarray(sc), jnp.asarray(dc), jnp.asarray(vc))
-            # np.array (not asarray): device outputs can be read-only
-            c, o = np.array(c)[:n], np.array(o)[:n]
-            for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
-                ws, wd = get_window(at + int(w))
-                c[w] = self.count(ws, wd, min_k=self.kb)
-            counts.extend(int(x) for x in c)
+            if pending is not None:
+                materialize(*pending)
+            pending = (at, n, c, o)
+        if pending is not None:
+            materialize(*pending)
         return counts
 
     def warm_chunks(self) -> None:
@@ -639,7 +705,7 @@ class TriangleWindowKernel:
         full-size zero streams). seg_ops.warm_stream_buckets is the
         shared body. A no-op when the numpy tier is selected — there
         is nothing to compile."""
-        if _resolve_stream_impl() == "host":
+        if _resolve_stream_impl() in ("host", "native"):
             return
         seg_ops.warm_stream_buckets(self)
 
@@ -656,7 +722,15 @@ class TriangleWindowKernel:
         dst = np.asarray(dst, np.int32)
         if len(src) == 0:
             return []
-        if _resolve_stream_impl() == "host":
+        impl = _resolve_stream_impl()
+        if impl == "native":
+            from .. import native as native_mod
+
+            counts = native_mod.triangle_count_stream(src, dst, self.eb)
+            if counts is not None:
+                return [int(x) for x in counts]
+            impl = "host"  # stale library: numpy tier stands in
+        if impl == "host":
             from . import host_triangles
 
             return host_triangles.count_stream(src, dst, self.eb)
@@ -681,7 +755,22 @@ class TriangleWindowKernel:
         numpy tier under the same selection as count_stream."""
         if not windows:
             return []
-        if _resolve_stream_impl() == "host":
+        impl = _resolve_stream_impl()
+        if impl == "native":
+            from .. import native as native_mod
+
+            out = []
+            for s, d in windows:
+                c = native_mod.triangle_count_stream(
+                    np.asarray(s), np.asarray(d), max(len(s), 1))
+                if c is None:
+                    out = None
+                    break
+                out.append(int(c[0]) if len(c) else 0)
+            if out is not None:
+                return out
+            impl = "host"  # stale library: numpy tier stands in
+        if impl == "host":
             from . import host_triangles
 
             return host_triangles.count_windows(windows)

@@ -71,6 +71,22 @@ def _resolve_reduce_impl(name: str) -> str:
     return impl
 
 
+def _host_identity(name: str, dtype):
+    """Monoid identity for the HOST (numpy) tiers and the reference
+    oracle — one definition so the tier, the oracle, and any future
+    parity fix cannot drift apart (the device tier's jnp form lives in
+    neighborhood._pane_identity, which uses finfo extremes instead of
+    ±inf for floats; cells with count 0 are compared by count, never
+    by value, so the two conventions never meet in an assertion)."""
+    dtype = np.dtype(dtype)
+    if name == "sum":
+        return 0
+    if np.issubdtype(dtype, np.integer):
+        return (np.iinfo(dtype).max if name == "min"
+                else np.iinfo(dtype).min)
+    return np.inf if name == "min" else -np.inf
+
+
 class WindowedEdgeReduce:
     """Per-window per-vertex reduce over tumbling `edge_bucket`-sized
     windows of a COO value stream.
@@ -213,13 +229,7 @@ class WindowedEdgeReduce:
         eb, vbp = self.eb, self.vb + 1
         n = len(src)
         num_w = -(-n // eb)
-        ident = {"sum": 0,
-                 "min": (np.iinfo(val.dtype).max
-                         if np.issubdtype(val.dtype, np.integer)
-                         else np.inf),
-                 "max": (np.iinfo(val.dtype).min
-                         if np.issubdtype(val.dtype, np.integer)
-                         else -np.inf)}[self.name]
+        ident = _host_identity(self.name, val.dtype)
         # The bincount fast path accumulates in float64, then casts
         # back. For integer values that is used only when the worst-
         # case cell sum (max|val| × contributions per cell — direction
@@ -279,13 +289,7 @@ def numpy_reference(src, dst, val, eb: int, direction: str = "out",
     with count 0 hold the monoid identity (cross-check counts, not
     values, for absence)."""
     op = {"sum": np.add, "min": np.minimum, "max": np.maximum}[name]
-    ident = {"sum": 0,
-             "min": (np.iinfo(np.asarray(val).dtype).max
-                     if np.issubdtype(np.asarray(val).dtype, np.integer)
-                     else np.inf),
-             "max": (np.iinfo(np.asarray(val).dtype).min
-                     if np.issubdtype(np.asarray(val).dtype, np.integer)
-                     else -np.inf)}[name]
+    ident = _host_identity(name, np.asarray(val).dtype)
     src = np.asarray(src, np.int64)
     dst = np.asarray(dst, np.int64)
     val = np.asarray(val)

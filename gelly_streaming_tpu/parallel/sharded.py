@@ -950,7 +950,8 @@ def make_sharded_summary_scan(mesh, eb: int, vb: int, kb: int, cap: int,
     return jax.jit(run)
 
 
-def make_sharded_snapshot_scan(mesh, vb: int, analytics: tuple):
+def make_sharded_snapshot_scan(mesh, vb: int, analytics: tuple,
+                               deltas: bool = False):
     """Sharded form of the driver's batched snapshot scan
     (core/driver._build_snapshot_scan): lax.scan over [W, eb] window
     stacks with the edge axis sharded over the mesh, carrying the
@@ -976,11 +977,17 @@ def make_sharded_snapshot_scan(mesh, vb: int, analytics: tuple):
             ones = jnp.where(valid, 1, 0)
             local = (jax.ops.segment_sum(ones, s, vb + 2)
                      + jax.ops.segment_sum(ones, d, vb + 2))
-            deg = deg + jax.lax.psum(local, SHARD_AXIS)
+            new_deg = deg + jax.lax.psum(local, SHARD_AXIS)
+            if deltas:
+                outs["deg_chg"] = new_deg[:vb] != deg[:vb]
+            deg = new_deg
             outs["deg"] = deg
         if want_cc:
-            labels = unionfind.cc_fixpoint(labels, s, d,
-                                           exchange=pmin_ex)
+            new_labels = unionfind.cc_fixpoint(labels, s, d,
+                                               exchange=pmin_ex)
+            if deltas:
+                outs["labels_chg"] = new_labels[:vb] != labels[:vb]
+            labels = new_labels
             outs["labels"] = labels
         if want_bip:
             sent2 = 2 * vb + 1
@@ -990,18 +997,31 @@ def make_sharded_snapshot_scan(mesh, vb: int, analytics: tuple):
             d2 = jnp.concatenate([
                 jnp.where(valid, dst + vb, sent2),
                 jnp.where(valid, dst, sent2)])
-            cover = unionfind.cc_fixpoint(cover, s2, d2,
-                                          exchange=pmin_ex)
+            new_cover = unionfind.cc_fixpoint(cover, s2, d2,
+                                              exchange=pmin_ex)
+            if deltas:
+                # mask tracks the consumer-visible odd flag (the
+                # same decode _run_batched applies), not raw labels
+                outs["cover_chg"] = (
+                    (new_cover[:vb] == new_cover[vb:2 * vb])
+                    != (cover[:vb] == cover[vb:2 * vb]))
+            cover = new_cover
             outs["cover"] = cover
         return (deg, labels, cover), outs
 
     out_tree = {}
     if want_deg:
         out_tree["deg"] = P()
+        if deltas:
+            out_tree["deg_chg"] = P()
     if want_cc:
         out_tree["labels"] = P()
+        if deltas:
+            out_tree["labels_chg"] = P()
     if want_bip:
         out_tree["cover"] = P()
+        if deltas:
+            out_tree["cover_chg"] = P()
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -1031,6 +1051,11 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
             k_bucket=k_bucket)
         self.eb = self._tri.eb
         self.vb = self._tri.vb
+        # same compile-size cap as the single-chip engine (the PER-
+        # DEVICE slice is eb/n, but the tunnel compiles the whole
+        # program; conservative is cheap here)
+        self.MAX_WINDOWS = min(type(self).MAX_WINDOWS,
+                               triangles._default_chunk(self.eb))
         self._run = make_sharded_summary_scan(
             mesh, self.eb, self.vb, self._tri.kb, self._tri.cap,
             table=self._tri.table)

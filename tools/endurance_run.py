@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""100M-edge endurance leg (VERDICT r3 item 8): prove stream_file's
+bounded-memory / O(log) recompile claims (core/driver.py:252-265) at
+10x the scale_run fixture, with a mid-stream crash + checkpoint resume.
+
+One pass over a 100M-edge generated file (same recipe as
+tools/scale_run.generate, 10x longer), all four analytics:
+
+  phase A — driver with auto-checkpoint every CKPT_EVERY windows
+            consumes the stream until CRASH_AT windows, then is
+            abandoned mid-iteration (a simulated hard crash: no
+            flush, no state handoff).
+  phase B — a FRESH driver try_resume()s the newest checkpoint and
+            re-feeds the same file with resume=True; the skip cursor
+            must land it exactly where the checkpoint left off.
+
+Measured throughout: RSS at every window batch (from /proc/self/status
+— the bounded-memory ceiling), XLA compile events (jax_log_compiles —
+steady-state tail must be compile-free), and end-of-stream invariants
+(windows_done * window size == edges_done == NUM_EDGES; sum(degrees)
+== 2 * edges folded since the degree vector's birth).
+
+Emits one JSON line per phase and writes ENDURANCE_r04.json.
+CPU-fallback friendly: backend is whatever jax picks (the claim under
+test is the host-side streaming discipline, not chip speed).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+NUM_EDGES = int(os.environ.get("GS_END_EDGES", 100_000_000))
+EDGES_PER_WINDOW = 65_536
+CKPT_EVERY = 64            # windows between checkpoints
+SEED_TAG = "endurance"
+
+os.environ["GS_SCALE_EDGES"] = str(NUM_EDGES)
+os.environ.setdefault("GS_SCALE_WINDOW", str(EDGES_PER_WINDOW))
+os.environ.setdefault("GS_SCALE_VEND", "262144")
+
+from tools.scale_run import CompileCounter, generate  # noqa: E402
+
+
+def rss_mb() -> float:
+    with open("/proc/self/status") as f:
+        for line in f:
+            if line.startswith("VmRSS"):
+                return int(line.split()[1]) / 1024.0
+    return float("nan")
+
+
+def run(fixture: str, out_path: str) -> None:
+    import logging
+
+    import jax
+    import numpy as np
+
+    from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+
+    jax.config.update("jax_log_compiles", True)
+    counter = CompileCounter()
+    logging.getLogger("jax").addHandler(counter)
+
+    total_windows = (NUM_EDGES + EDGES_PER_WINDOW - 1) // EDGES_PER_WINDOW
+    crash_at = total_windows // 2
+    ckpt = os.path.join(os.path.dirname(fixture), "endurance.ckpt")
+    rows = []
+
+    def leg(name):
+        t0 = time.perf_counter()
+        rss_samples = []
+        compiles_before = len(counter.events)
+
+        def finish(driver, windows, edges, tail_compiles):
+            row = {
+                "leg": name,
+                "backend": jax.default_backend(),
+                "windows": windows,
+                "edges": edges,
+                "seconds": round(time.perf_counter() - t0, 1),
+                "edges_per_s": round(edges / max(
+                    time.perf_counter() - t0, 1e-9)),
+                "rss_mb_p10": round(float(np.percentile(rss_samples, 10))),
+                "rss_mb_max": round(max(rss_samples)),
+                "compiles": len(counter.events) - compiles_before,
+                "compiles_steady_state_tail": tail_compiles,
+                "windows_done": driver.windows_done,
+                "edges_done": driver.edges_done,
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            return row
+
+        return rss_samples, finish
+
+    # ---- phase A: run to the crash point under auto-checkpoint
+    drv = StreamingAnalyticsDriver(window_ms=1000)
+    drv.enable_auto_checkpoint(ckpt, every_n_windows=CKPT_EVERY)
+    rss_samples, finish = leg("endurance_phase_a_crash")
+    windows = edges = 0
+    for res in drv.stream_file(fixture):
+        windows += 1
+        edges += res.num_edges
+        if windows % 16 == 0:
+            rss_samples.append(rss_mb())
+        if windows >= crash_at:
+            break      # simulated crash: abandon mid-iteration
+    finish(drv, windows, edges, tail_compiles=-1)
+    del drv
+
+    # ---- phase B: fresh driver, resume from the newest checkpoint,
+    # steady-state tail must be compile-free (buckets stopped growing
+    # long before the crash point: V_END << edges at 50%)
+    drv = StreamingAnalyticsDriver(window_ms=1000)
+    assert drv.try_resume(ckpt), "checkpoint did not restore"
+    resumed_at = drv.windows_done
+    assert resumed_at <= crash_at, (resumed_at, crash_at)
+    assert resumed_at >= crash_at - CKPT_EVERY, (resumed_at, crash_at)
+    drv.enable_auto_checkpoint(ckpt, every_n_windows=CKPT_EVERY)
+    rss_samples, finish = leg("endurance_phase_b_resume")
+    windows = edges = 0
+    tail_from = (total_windows * 3) // 4
+    tail_compiles = 0
+    seen_events = len(counter.events)
+    deg_sum = None
+    for res in drv.stream_file(fixture, resume=True):
+        windows += 1
+        edges += res.num_edges
+        if windows % 16 == 0:
+            rss_samples.append(rss_mb())
+        new = len(counter.events) - seen_events
+        seen_events = len(counter.events)
+        if drv.windows_done > tail_from and new:
+            tail_compiles += new
+        deg_sum = res.degrees
+    row = finish(drv, windows, edges, tail_compiles)
+
+    # ---- invariants: nothing dropped, nothing double-counted
+    assert drv.windows_done == total_windows, (
+        drv.windows_done, total_windows)
+    assert drv.edges_done == NUM_EDGES, (drv.edges_done, NUM_EDGES)
+    assert int(deg_sum.sum()) == 2 * NUM_EDGES, (
+        int(deg_sum.sum()), 2 * NUM_EDGES)
+    assert row["compiles_steady_state_tail"] == 0, row
+    # bounded memory: the post-warmup ceiling is flat (max within 20%
+    # of the p10 once past the first quarter of phase B)
+    assert row["rss_mb_max"] <= 1.2 * row["rss_mb_p10"] + 512, row
+    rows.append({"leg": "endurance_invariants", "ok": True,
+                 "total_windows": total_windows,
+                 "resumed_at_window": resumed_at,
+                 "crash_at_window": crash_at})
+    print(json.dumps(rows[-1]), flush=True)
+    with open(out_path, "w") as f:
+        json.dump(rows, f, indent=1)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--fixture", default="/tmp/gs_endurance.txt")
+    ap.add_argument("--out", default=os.path.join(
+        REPO, "ENDURANCE_r04.json"))
+    args = ap.parse_args()
+    if not os.path.exists(args.fixture) or \
+            os.path.getsize(args.fixture) < NUM_EDGES * 10:
+        generate(args.fixture)
+    run(args.fixture, args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -168,15 +168,20 @@ def section_window(results: dict) -> None:
         # kernel default — otherwise successive profiling runs ratchet
         # K downward and can never re-explore larger values
         default_kb = min(128, 2 * int(np.sqrt(eb)))
+        # the sweeps' chunk anchor: deterministic per (backend, eb) —
+        # the compile-size-capped default on the tunneled chip (the
+        # 64×32768-edge program wedged the remote compiler >25 min in
+        # the round-4 window; ops/triangles._default_chunk), the class
+        # default elsewhere. Same ratchet guard as K: committed picks
+        # never set the conditions the sweep measures under.
+        from gelly_streaming_tpu.ops.triangles import _default_chunk
+
+        anchor_chunk = _default_chunk(eb)
         kernels = {}
         for kb in sorted({default_kb, default_kb // 2, default_kb // 4}):
             kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
                                         k_bucket=kb)
-            # anchor the chunk size too (same ratchet guard as K): a
-            # committed chunk pick must not set the conditions the
-            # k-sweep is measured under, or successive profiling runs
-            # stop being comparable
-            kern.MAX_STREAM_WINDOWS = TriangleWindowKernel.MAX_STREAM_WINDOWS
+            kern.MAX_STREAM_WINDOWS = anchor_chunk
             kernels[kern.kb] = kern
             # one instrumented pass counts the overflow recounts an
             # undersized K pays (and warms every program it needs),
@@ -215,20 +220,26 @@ def section_window(results: dict) -> None:
         row["chunk_sweep_overflow_recounts"] = _count_overflow_recounts(
             kern, csrc, cdst)
         row["chunk_sweep"] = []
-        for cs in (32, 64, 128):
+        if jax.default_backend() == "tpu":
+            # stay under the compile-size wedge line (see anchor note)
+            cs_values = sorted({max(1, anchor_chunk // 4),
+                                max(1, anchor_chunk // 2), anchor_chunk})
+        else:
+            cs_values = [32, 64, 128]
+        for cs in cs_values:
             kern.MAX_STREAM_WINDOWS = cs
             kern._count_stream_device(csrc, cdst)  # warm this chunk shape
             t = _timeit(lambda: kern._count_stream_device(csrc, cdst),
                         reps=3, warmup=0)
             row["chunk_sweep"].append({
                 "windows_per_dispatch": cs,
-                "default": cs == TriangleWindowKernel.MAX_STREAM_WINDOWS,
+                "default": cs == anchor_chunk,
                 "per_window_ms": round(t / cnum_w * 1e3, 3),
                 "edges_per_s": round(cnum_w * eb / t),
             })
         # leave the kernel at the anchor chunk (the instance attr is
         # always set now — __init__ tunes it, this sweep overwrote it)
-        kern.MAX_STREAM_WINDOWS = TriangleWindowKernel.MAX_STREAM_WINDOWS
+        kern.MAX_STREAM_WINDOWS = anchor_chunk
         out.append(row)
     results["window"] = out
 
@@ -410,11 +421,13 @@ def section_roofline(results: dict) -> None:
 
     rows = []
     # --- the streaming window program at both bench buckets, exactly
-    # as the bench dispatches it (tuned K, 64-window chunk)
+    # as the bench dispatches it (tuned K, tuned/compile-capped chunk —
+    # the 64×32768 program wedged the tunnel's remote compiler, see
+    # ops/triangles._default_chunk)
     for eb in (8_192, 32_768):
         vb = 2 * eb
-        num_w = 64
         kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+        num_w = kern.MAX_STREAM_WINDOWS
         src, dst = _stream(num_w * eb, vb)
         from gelly_streaming_tpu.ops import segment as seg_ops
 
@@ -480,9 +493,12 @@ def section_trace(results: dict) -> None:
     from gelly_streaming_tpu.ops import segment as seg_ops
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
-    eb, num_w = 32_768, 64
+    eb = 32_768
     vb = 2 * eb
     kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    # the production chunk (compile-capped on the tunnel: the 64×32768
+    # program wedged the remote compiler — ops/triangles._default_chunk)
+    num_w = kern.MAX_STREAM_WINDOWS
     src, dst = _stream(num_w * eb, vb)
     _, s, d, valid = seg_ops.window_stack(src, dst, kern.eb,
                                           sentinel=kern.vb)
@@ -540,6 +556,8 @@ def section_host_stream(results: dict) -> None:
     from gelly_streaming_tpu.ops import host_triangles
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
+    from gelly_streaming_tpu import native
+
     sizes = (8_192, 32_768)
     if jax.default_backend() == "cpu":
         sizes = sizes + (65_536,)
@@ -555,13 +573,22 @@ def section_host_stream(results: dict) -> None:
                         reps=3, warmup=0)
         t_host = _timeit(lambda: host_triangles.count_stream(
             src, dst, eb), reps=3, warmup=0)
-        out.append({
+        row = {
             "edge_bucket": eb, "windows": num_w,
             "parity": host == dev,
             "host_edges_per_s": round(num_w * eb / t_host),
             "device_edges_per_s": round(num_w * eb / t_dev),
             "host_vs_device": round(t_dev / t_host, 2),
-        })
+        }
+        if native.triangles_available():
+            # the C++ compact-forward tier (native/ingest.cpp) competes
+            # under the same committed-evidence rule
+            nat = native.triangle_count_stream(src, dst, eb)
+            t_nat = _timeit(lambda: native.triangle_count_stream(
+                src, dst, eb), reps=3, warmup=0)
+            row["native_parity"] = list(nat) == dev
+            row["native_edges_per_s"] = round(num_w * eb / t_nat)
+        out.append(row)
     results["host_stream"] = out
 
 
@@ -902,6 +929,24 @@ def main():
         path = perf_path if usable else perf_path + ".partial"
         with open(path, "w") as f:
             json.dump(merged, f, indent=2)
+        if ok_sections and backend:
+            # per-backend archive: this backend's selections must keep
+            # their committed rows even after the OTHER backend's
+            # profile run takes over PERF.json
+            # (ops/triangles._load_matching_perf falls back to it).
+            # Seeded from the EXISTING archive so a subset run (e.g.
+            # host_stream only) keeps the other archived sections.
+            arch_path = os.path.join(REPO, "PERF_%s.json" % backend)
+            try:
+                with open(arch_path) as f:
+                    arch = json.load(f)
+                if arch.get("backend") != backend:
+                    arch = {}
+            except (OSError, ValueError):
+                arch = {}
+            arch.update(merged)
+            with open(arch_path, "w") as f:
+                json.dump(arch, f, indent=2)
         wrote[0] = path
 
     chip_sections = [s for s in want if s != "sharded"]
