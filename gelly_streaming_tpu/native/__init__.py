@@ -67,9 +67,25 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
             ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
         ]
+        lib.gs_windowed_reduce.restype = ctypes.c_int64
+        lib.gs_windowed_reduce.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.gs_windowed_reduce_i32.restype = ctypes.c_int64
+        lib.gs_windowed_reduce_i32.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
     except AttributeError:
-        # a stale pre-triangle libgsnative.so: everything else still
-        # works; triangle_count_stream() reports unavailable
+        # a stale libgsnative.so missing newer symbols: everything else
+        # still works; the affected helpers report unavailable
         pass
     _lib = lib
     return _lib
@@ -167,6 +183,59 @@ def triangle_count_stream(src: np.ndarray, dst: np.ndarray,
     w = _lib.gs_triangle_count_stream(_i64ptr(src), _i64ptr(dst),
                                       len(src), eb, _i64ptr(counts))
     return counts[:w]
+
+
+_REDUCE_OPS = {"sum": 0, "min": 1, "max": 2}
+_REDUCE_DIRS = {"out": 0, "in": 1, "all": 2}
+
+
+def windowed_reduce_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "gs_windowed_reduce")
+
+
+def windowed_reduce(src: np.ndarray, dst: np.ndarray, val: np.ndarray,
+                    eb: int, vbp: int, name: str, direction: str,
+                    ident: int):
+    """Fused (cells, counts) windowed reduce via the C++ kernel
+    (ingest.cpp gs_windowed_reduce) — the native tier of
+    ops/windowed_reduce.WindowedEdgeReduce for integer values. Returns
+    (cells [num_w, vbp] int64, counts [num_w, vbp] int64), cells
+    pre-filled with `ident`; None when the library/symbol is
+    unavailable (callers fall back to the numpy tier)."""
+    if not windowed_reduce_available():
+        return None
+    n = len(src)
+    num_w = -(-n // eb) if n else 0
+    if ident == 0:
+        # calloc-backed zeros: the kernel touches only real cells, so
+        # the identity fill is free (np.full writes the whole slab)
+        cells = np.zeros((max(num_w, 1), vbp), np.int64)
+    else:
+        cells = np.full((max(num_w, 1), vbp), ident, np.int64)
+    counts = np.zeros((max(num_w, 1), vbp), np.int64)
+    arrs = [np.asarray(a) for a in (src, dst, val)]
+    if all(a.dtype == np.int32 for a in arrs):
+        src32, dst32, val32 = (np.ascontiguousarray(a) for a in arrs)
+        oob = _lib.gs_windowed_reduce_i32(
+            _i32ptr(src32), _i32ptr(dst32), _i32ptr(val32), n, eb,
+            vbp, _REDUCE_OPS[name], _REDUCE_DIRS[direction],
+            _i64ptr(cells), _i64ptr(counts))
+    else:
+        src = np.ascontiguousarray(src, np.int64)
+        dst = np.ascontiguousarray(dst, np.int64)
+        val = np.ascontiguousarray(val, np.int64)
+        oob = _lib.gs_windowed_reduce(
+            _i64ptr(src), _i64ptr(dst), _i64ptr(val), n, eb, vbp,
+            _REDUCE_OPS[name], _REDUCE_DIRS[direction],
+            _i64ptr(cells), _i64ptr(counts))
+    if oob:
+        # other tiers fail loudly on bad ids (bincount raises); the
+        # C++ kernel skips them and reports — surface it identically
+        raise ValueError(
+            "%d vertex id(s) outside [0, %d) in windowed_reduce input"
+            % (oob, vbp))
+    return cells[:num_w], counts[:num_w]
 
 
 class NativeInterner:

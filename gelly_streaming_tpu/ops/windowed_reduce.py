@@ -39,7 +39,7 @@ _DIRECTIONS = ("out", "in", "all")
 _REDUCE_IMPL = {}   # name -> "device" | "host", resolved once per process
 
 
-def _resolve_reduce_impl(name: str) -> str:
+def _resolve_reduce_impl(name: str, allow_native: bool = True) -> str:
     """Columnar-reduce tier for monoid `name`: the device segment
     kernels by default; the vectorized host kernel (flattened
     one-bincount-per-chunk for sum, ufunc.at otherwise) only on a CPU
@@ -48,8 +48,9 @@ def _resolve_reduce_impl(name: str) -> str:
     same measured-default policy as `triangles._resolve_stream_impl`
     (a CPU fallback may select the kernel that actually wins on a CPU;
     the chip path is untouched)."""
-    if name in _REDUCE_IMPL:
-        return _REDUCE_IMPL[name]
+    key = (name, allow_native)
+    if key in _REDUCE_IMPL:
+        return _REDUCE_IMPL[key]
     impl = "device"
     try:
         import jax as _jax
@@ -65,9 +66,23 @@ def _resolve_reduce_impl(name: str) -> str:
                             >= 1.05 * (r.get("device_edges_per_s") or 0)
                             for r in rows):
                 impl = "host"
+            # the C++ fused tier (native/ingest.cpp
+            # gs_windowed_reduce) competes under the same rule: parity
+            # + ≥5% over BOTH other tiers at every measured bucket
+            if allow_native and rows \
+                    and all(r.get("native_parity") is True
+                            and (r.get("native_edges_per_s") or 0)
+                            >= 1.05 * max(
+                                r.get("device_edges_per_s") or 0,
+                                r.get("host_edges_per_s") or 0)
+                            for r in rows):
+                from .. import native as _native
+
+                if _native.windowed_reduce_available():
+                    impl = "native"
     except Exception:
         pass
-    _REDUCE_IMPL[name] = impl
+    _REDUCE_IMPL[key] = impl
     return impl
 
 
@@ -117,6 +132,13 @@ class WindowedEdgeReduce:
         assert name in (None, "sum", "min", "max"), name
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.eb = seg_ops.bucket_size(edge_bucket)
+        # compile-size cap on the tunneled chip (the bench's reduce leg
+        # timed out in the round-4 window before this cap existed —
+        # ops/triangles._default_chunk has the evidence)
+        from . import triangles as _tri
+
+        self.MAX_STREAM_WINDOWS = min(type(self).MAX_STREAM_WINDOWS,
+                                      _tri._default_chunk(self.eb))
         self.name = name
         self.fn = fn
         self.direction = direction
@@ -163,6 +185,9 @@ class WindowedEdgeReduce:
     def process_stream(self, src: np.ndarray, dst: np.ndarray,
                        val: np.ndarray) -> List[Tuple[np.ndarray,
                                                       np.ndarray]]:
+        # original dtypes preserved for the native tier (int32 streams
+        # take the copy-free i32 kernel); the other tiers get int64
+        src0, dst0 = np.asarray(src), np.asarray(dst)
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
         val = np.asarray(val)
@@ -170,10 +195,53 @@ class WindowedEdgeReduce:
         n = len(src)
         if n == 0:
             return []
-        if (self.name is not None
-                and _resolve_reduce_impl(self.name) == "host"):
-            return self._host_process_stream(src, dst, val)
+        if self.name is not None:
+            impl = _resolve_reduce_impl(self.name)
+            if impl == "native":
+                # the C++ kernel is signed-integer-typed (selection
+                # rows are measured on ints; uint64 identities don't
+                # fit its int64 slabs) — other dtypes re-resolve as if
+                # the native tier didn't exist, so a float stream goes
+                # wherever ITS committed rows point, not blindly to
+                # the numpy tier
+                if np.issubdtype(val.dtype, np.signedinteger):
+                    got = self._native_process_stream(src0, dst0, val)
+                    if got is not None:
+                        return got
+                impl = _resolve_reduce_impl(self.name,
+                                            allow_native=False)
+            if impl == "host":
+                return self._host_process_stream(src, dst, val)
         return self._device_process_stream(src, dst, val)
+
+    def _native_process_stream(self, src, dst, val):
+        """The C++ fused tier: one pass produces both cells and counts
+        (ingest.cpp gs_windowed_reduce), chunked only to bound the
+        dense [num_w, vbp] scratch. Same (cells, counts) per window as
+        the other tiers; cells cast back to the value dtype."""
+        from .. import native as native_mod
+
+        if not native_mod.windowed_reduce_available():
+            return None
+        eb, vbp = self.eb, self.vb + 1
+        n = len(src)
+        num_w = -(-n // eb)
+        ident = int(_host_identity(self.name, val.dtype))
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        # chunk by a ~64MB dense-scratch budget (two [chunk_w, vbp]
+        # int64 slabs): chunk size only amortizes ctypes call overhead,
+        # so a big vertex bucket just takes more, smaller calls instead
+        # of multi-GB allocations
+        chunk_w = max(1, min(1024, (64 << 20) // (vbp * 16)))
+        for at in range(0, num_w, chunk_w):
+            lo, hi = at * eb, min((at + chunk_w) * eb, n)
+            cells, counts = native_mod.windowed_reduce(
+                src[lo:hi], dst[lo:hi], val[lo:hi], eb, vbp,
+                self.name, self.direction, ident)
+            cells = cells.astype(val.dtype, copy=False)
+            out.extend((cells[w], counts[w])
+                       for w in range(cells.shape[0]))
+        return out
 
     def _device_process_stream(self, src, dst, val):
         """The device path, selection bypassed (the profiler measures
@@ -246,6 +314,34 @@ class WindowedEdgeReduce:
                               <= limit)
         else:
             exact_bincount = self.name == "sum"
+        if exact_bincount:
+            # per-window bincounts: no flattened (window, vertex) cell
+            # ids to materialize and no chunk-wide minlength slab —
+            # ~3x the flattened form's rate on one core (the cell-id
+            # multiply-add and the giant bincount were the cost, not
+            # the per-window Python loop)
+            for lo in range(0, n, eb):
+                s, d, v = src[lo:lo + eb], dst[lo:lo + eb], \
+                    val[lo:lo + eb]
+                if self.direction == "out":
+                    ids, vals = s, v
+                elif self.direction == "in":
+                    ids, vals = d, v
+                else:
+                    ids = np.concatenate([s, d])
+                    vals = np.concatenate([v, v])
+                counts = np.bincount(ids, minlength=vbp)
+                if len(counts) > vbp:
+                    # the flattened path's reshape raised for ids ≥
+                    # vbp; this path must fail as loudly, not emit a
+                    # ragged window
+                    raise ValueError(
+                        "vertex id %d outside [0, %d) in windowed "
+                        "reduce input" % (int(ids.max()), vbp))
+                cells = np.bincount(
+                    ids, weights=vals, minlength=vbp).astype(val.dtype)
+                out.append((cells, counts))
+            return out
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
             hi_w = min(at + self.MAX_STREAM_WINDOWS, num_w)
             lo, hi = at * eb, min(hi_w * eb, n)
@@ -263,17 +359,11 @@ class WindowedEdgeReduce:
             n_cells = wb * vbp
             counts = np.bincount(ids, minlength=n_cells).reshape(
                 wb, vbp)
-            if exact_bincount:
-                cells = np.bincount(
-                    ids, weights=vals,
-                    minlength=n_cells).astype(val.dtype).reshape(
-                    wb, vbp)
-            else:
-                op = {"sum": np.add, "min": np.minimum,
-                      "max": np.maximum}[self.name]
-                flat = np.full(n_cells, ident, val.dtype)
-                op.at(flat, ids, vals)
-                cells = flat.reshape(wb, vbp)
+            op = {"sum": np.add, "min": np.minimum,
+                  "max": np.maximum}[self.name]
+            flat = np.full(n_cells, ident, val.dtype)
+            op.at(flat, ids, vals)
+            cells = flat.reshape(wb, vbp)
             for w in range(wb):
                 out.append((cells[w], counts[w]))
         return out

@@ -109,6 +109,111 @@ def test_columnar_million_edge_fuzz():
         np.testing.assert_array_equal(gc[:nv], wc)
 
 
+needs_native_reduce = pytest.mark.skipif(
+    not __import__("gelly_streaming_tpu.native",
+                   fromlist=["x"]).windowed_reduce_available(),
+    reason="libgsnative.so lacks gs_windowed_reduce")
+
+
+@needs_native_reduce
+@pytest.mark.parametrize("direction", ["out", "in", "all"])
+@pytest.mark.parametrize("name", ["sum", "min", "max"])
+def test_native_reduce_tier_matches_numpy(direction, name):
+    """The C++ fused tier (native/ingest.cpp gs_windowed_reduce):
+    identical (cells, counts) to the numpy tier on ragged, skewed,
+    duplicate-heavy streams — both the i32 fast path and the i64
+    form."""
+    from gelly_streaming_tpu.ops import windowed_reduce as wr
+
+    rng = np.random.default_rng(47)
+    n, nv, eb = 9_500, 700, 1024
+    src = (rng.zipf(1.4, n) % nv).astype(np.int64)
+    dst = rng.integers(0, nv, n)
+    val = rng.integers(-50, 1000, n).astype(np.int32)
+    eng = WindowedEdgeReduce(vertex_bucket=nv, edge_bucket=eb,
+                             name=name, direction=direction)
+    want = eng._host_process_stream(src, dst, val)
+    for cast in (np.int32, np.int64):   # i32 fast path + i64 form
+        got = eng._native_process_stream(src.astype(cast),
+                                         dst.astype(cast), val)
+        assert got is not None and len(got) == len(want)
+        for (gc, gn), (wc, wn) in zip(got, want):
+            np.testing.assert_array_equal(gn, wn)
+            occ = wn > 0
+            np.testing.assert_array_equal(
+                gc[occ] if name != "sum" else gc,
+                wc[occ] if name != "sum" else wc)
+
+
+@needs_native_reduce
+def test_native_reduce_selected_end_to_end(tmp_path, monkeypatch):
+    """Committed rows where the native tier wins route process_stream
+    through C++ for integer values (and keep numpy for floats)."""
+    import json
+
+    from gelly_streaming_tpu.ops import triangles as tri_ops
+    from gelly_streaming_tpu.ops import windowed_reduce as wr
+
+    monkeypatch.setattr(tri_ops, "_PERF_PATH",
+                        str(tmp_path / "PERF.json"))
+    monkeypatch.setattr(wr, "_REDUCE_IMPL", {})
+    (tmp_path / "PERF.json").write_text(json.dumps({
+        "backend": "cpu",
+        "host_reduce": [{"name": "sum", "edge_bucket": 8192,
+                         "parity": True,
+                         "host_edges_per_s": 60_000_000,
+                         "device_edges_per_s": 20_000_000,
+                         "native_parity": True,
+                         "native_edges_per_s": 120_000_000}]}))
+    try:
+        assert wr._resolve_reduce_impl("sum") == "native"
+        rng = np.random.default_rng(3)
+        src = rng.integers(0, 100, 3000).astype(np.int32)
+        dst = rng.integers(0, 100, 3000).astype(np.int32)
+        val = rng.integers(1, 50, 3000).astype(np.int32)
+        eng = WindowedEdgeReduce(vertex_bucket=128, edge_bucket=512,
+                                 name="sum", direction="all")
+        got = eng.process_stream(src, dst, val)
+        want = numpy_reference(src, dst, val, 512, "all", "sum")
+        for (gc, gn), (wc, wn) in zip(got, want):
+            np.testing.assert_array_equal(gc[:100], wc[:100])
+            np.testing.assert_array_equal(gn[:100], wn[:100])
+        # float values: numpy tier stands in transparently
+        fval = val.astype(np.float32)
+        gotf = eng.process_stream(src, dst, fval)
+        wantf = numpy_reference(src, dst, fval, 512, "all", "sum")
+        for (gc, gn), (wc, wn) in zip(gotf, wantf):
+            np.testing.assert_allclose(gc[:100], wc[:100])
+    finally:
+        monkeypatch.undo()
+        wr._REDUCE_IMPL.clear()
+
+
+@needs_native_reduce
+def test_native_reduce_rejects_out_of_range_ids():
+    """The C++ kernel must fail as loudly as the other tiers on bad
+    ids (bincount raises) — never write outside its slabs."""
+    from gelly_streaming_tpu import native
+
+    for bad in (np.array([900], np.int32), np.array([-1], np.int32)):
+        with pytest.raises(ValueError, match="outside"):
+            native.windowed_reduce(bad, np.array([1], bad.dtype),
+                                   np.array([7], bad.dtype), 4, 10,
+                                   "sum", "out", 0)
+
+
+def test_host_sum_fast_path_rejects_out_of_range_ids():
+    """The per-window bincount fast path must raise (like the
+    flattened path's reshape did), not emit a ragged window."""
+    eng = WindowedEdgeReduce(vertex_bucket=64, edge_bucket=32,
+                             name="sum", direction="out")
+    src = np.array([1, 2, 200], np.int64)   # 200 >= vbp=65
+    dst = np.array([3, 4, 5], np.int64)
+    val = np.ones(3, np.int32)
+    with pytest.raises(ValueError, match="outside"):
+        eng._host_process_stream(src, dst, val)
+
+
 def test_associative_fn_tier_matches_monoid():
     """fn=jnp.minimum through the flagged associative scan equals
     name='min' through the segment kernels — and a non-monoid

@@ -601,6 +601,8 @@ def section_host_reduce(results: dict) -> None:
 
     from gelly_streaming_tpu.ops.windowed_reduce import WindowedEdgeReduce
 
+    from gelly_streaming_tpu import native
+
     rows = []
     for name, eb in (("sum", 8_192), ("sum", 32_768), ("min", 8_192)):
         nv = 2 * eb
@@ -621,13 +623,29 @@ def section_host_reduce(results: dict) -> None:
             src, dst, val), reps=3, warmup=0)
         t_host = _timeit(lambda: eng._host_process_stream(
             src, dst, val), reps=3, warmup=0)
-        rows.append({
+        row = {
             "name": name, "edge_bucket": eb, "windows": num_w,
             "parity": parity,
             "host_edges_per_s": round(num_w * eb / t_host),
             "device_edges_per_s": round(num_w * eb / t_dev),
             "host_vs_device": round(t_dev / t_host, 2),
-        })
+        }
+        if native.windowed_reduce_available():
+            # the C++ fused tier competes under the same committed-
+            # evidence rule (it currently LOSES to the per-window
+            # bincount form on this host — the honest row keeps it
+            # deselected)
+            nat = eng._native_process_stream(src, dst, val)
+            row["native_parity"] = nat is not None and all(
+                (np.array_equal(nc[:nv], hc[:nv]) if name == "sum"
+                 else np.array_equal(nc[:nv][hn[:nv] > 0],
+                                     hc[:nv][hn[:nv] > 0]))
+                and np.array_equal(nn[:nv], hn[:nv])
+                for (nc, nn), (hc, hn) in zip(nat, host))
+            t_nat = _timeit(lambda: eng._native_process_stream(
+                src, dst, val), reps=3, warmup=0)
+            row["native_edges_per_s"] = round(num_w * eb / t_nat)
+        rows.append(row)
     results["host_reduce"] = rows
 
 
