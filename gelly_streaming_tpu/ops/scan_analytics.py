@@ -19,7 +19,15 @@ the carried-state semantics of the reference's continuous aggregates):
   tri_overflow    — hub outran the K bucket (host recounts exactly)
 
 Full per-vertex snapshots remain the driver's job; this engine is the
-throughput path (bench.py, examples/measurements.py --fused).
+CHIP-side throughput path. On CPU backends the driver wins instead —
+measured, not argued (FUSED_BREAKDOWN.json, tools/
+profile_fused_breakdown.py): the triangle stage compiled INTO this
+scan is the XLA stream program, which a single core runs ~15x slower
+than the measurement-selected numpy tier the driver routes through,
+while the dispatch latency fusion saves is ~µs off-chip. One program
+per chunk only pays when dispatches cost ~0.2s (the tunneled chip)
+and the MXU/VPU runs the intersect — exactly the regime this engine
+was built for.
 """
 
 from __future__ import annotations

@@ -816,7 +816,22 @@ def triangle_count(src: np.ndarray, dst: np.ndarray, num_vertices: int) -> int:
     """Pick the MXU dense path for small windows, wedge path otherwise.
     The dense implementation (XLA matmul vs Pallas fused contraction)
     is selected by `_resolve_dense_choice` from committed on-chip
-    measurements."""
+    measurements; on CPU backends the measured host tier takes the
+    whole window (same `_resolve_stream_impl` evidence as
+    count_stream — identical counts, no dispatch)."""
+    tier = _resolve_stream_impl()
+    if tier == "native":
+        from .. import native as native_mod
+
+        counts = native_mod.triangle_count_stream(
+            np.asarray(src), np.asarray(dst), max(len(src), 1))
+        if counts is not None:
+            return int(counts[0]) if len(counts) else 0
+        tier = "host"
+    if tier == "host":
+        from . import host_triangles
+
+        return host_triangles.window_count(src, dst)
     impl, limit = _resolve_dense_choice()
     if num_vertices <= limit:
         if impl == "pallas":
