@@ -1,0 +1,144 @@
+"""Compact h2d ingress for batched window dispatches.
+
+The standard stream-chunk format (seg_ops.window_stack →
+TriangleWindowKernel._run_stack) ships 9 bytes per edge-slot to the
+device: src int32 + dst int32 + valid bool. Two structural facts make
+a 4-bytes/slot form lossless:
+
+  1. vertex ids fit uint16 whenever the vertex bucket ≤ 65536 (every
+     bench scale, and any interned window of ≤64K distinct vertices);
+  2. padding is always a per-window SUFFIX (window_stack /
+     stack_window_list fill tails), so the [wb, eb] bool mask is
+     reconstructible on device from ONE int32 valid-count per window.
+
+The device side widens uint16 → int32 and rebuilds (valid, sentinel)
+with a VPU-cheap `where` before the unchanged window program — same
+counts, 2.25× fewer h2d bytes. On the tunneled chip the end-to-end
+stream rate is transfer/dispatch bound (PERF.md "VERIFIED chip rows"),
+so ingress bytes are directly on the critical path; on real
+deployments this is the PCIe/DCN ingest-bandwidth lever.
+
+Adoption is evidence-gated like every other selection
+(ops/triangles.py `_resolve_*` family): the kernel only switches to
+compact ingress when a committed backend-matched `ingress_ab` row
+(tools/ingress_ab.py) shows parity and a ≥5% end-to-end win.
+
+Design provenance: the reference streams edges as (int,int) tuples
+through Flink's network stack (SimpleEdgeStream.java:60-90); the
+columnar re-design makes the wire format an explicit, measurable
+choice.
+"""
+
+import numpy as np
+
+MAX_U16_VB = 65536  # ids ≤ 65535 fit; the sentinel is rebuilt on device
+
+
+def supports(vb: int) -> bool:
+    """Compact ingress is lossless iff every REAL id < 65536; padded
+    slots carry zeros and are masked by the rebuilt valid mask."""
+    return vb <= MAX_U16_VB
+
+
+def build_stream_fn(window_fn, vb: int, eb: int):
+    """The compact twin of TriangleWindowKernel._build_stream: widen
+    uint16 ids, rebuild the suffix mask from per-window counts, then
+    lax.map the SAME per-window program. Returns an un-jitted callable
+    (callers jit/AOT-compile it alongside the standard form)."""
+    import jax
+    import jax.numpy as jnp
+
+    def run_stream(src16, dst16, nvalid):  # [wb, eb] u16, [wb] i32
+        pos = jnp.arange(eb, dtype=jnp.int32)[None, :]
+        valid = pos < nvalid[:, None]
+        s = jnp.where(valid, src16.astype(jnp.int32), vb)
+        d = jnp.where(valid, dst16.astype(jnp.int32), vb)
+        return jax.lax.map(lambda t: window_fn(*t), (s, d, valid))
+
+    return run_stream
+
+
+def window_stack(src: np.ndarray, dst: np.ndarray, eb: int):
+    """Compact form of seg_ops.window_stack: [W, eb] uint16 stacks +
+    [W] int32 valid counts (padding implied as each window's suffix)."""
+    n = len(src)
+    num_w = -(-n // eb)
+    s16 = np.zeros(num_w * eb, np.uint16)
+    d16 = np.zeros(num_w * eb, np.uint16)
+    s16[:n] = src.astype(np.uint16)
+    d16[:n] = dst.astype(np.uint16)
+    nvalid = np.full(num_w, eb, np.int32)
+    if n % eb:
+        nvalid[-1] = n % eb
+    return num_w, s16.reshape(num_w, eb), d16.reshape(num_w, eb), nvalid
+
+
+def stack_window_list(windows, eb: int):
+    """Compact form of seg_ops.stack_window_list (driver event-time
+    windows): per-window uint16 rows + valid counts."""
+    num_w = len(windows)
+    s16 = np.zeros((num_w, eb), np.uint16)
+    d16 = np.zeros((num_w, eb), np.uint16)
+    nvalid = np.zeros(num_w, np.int32)
+    for w, (ws, wd) in enumerate(windows):
+        k = len(ws)
+        if k > eb:
+            raise ValueError(f"window of {k} edges exceeds edge "
+                             f"bucket {eb}")
+        s16[w, :k] = np.asarray(ws, np.uint16)
+        d16[w, :k] = np.asarray(wd, np.uint16)
+        nvalid[w] = k
+    return s16, d16, nvalid
+
+
+def run_stack(kernel, run, src, dst):
+    """The compact-format twin of TriangleWindowKernel._run_stack —
+    the ONE place the depth-2 pipelined chunk loop + hub-overflow
+    recount policy exists for compact ingress (the A/B tool and the
+    parity tests both call this, so the measured form IS the adopted
+    form). `run` is the compiled build_stream_fn program."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    eb = kernel.eb
+    max_w = kernel.MAX_STREAM_WINDOWS
+    num_w, s16, d16, nvalid = window_stack(src, dst, eb)
+
+    counts = []
+    pending = None
+
+    def materialize(at, nw, c_dev, o_dev):
+        c, o = np.array(c_dev)[:nw], np.array(o_dev)[:nw]
+        for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
+            lo = (at + int(w)) * eb
+            c[w] = kernel.count(src[lo:lo + eb], dst[lo:lo + eb],
+                                min_k=kernel.kb)
+        counts.extend(int(x) for x in c)
+
+    for at in range(0, num_w, max_w):
+        hi = min(at + max_w, num_w)
+        sc, dc, nv, nw = pad_chunk(s16, d16, nvalid, at, hi, max_w, eb)
+        c, o = run(jnp.asarray(sc), jnp.asarray(dc), jnp.asarray(nv))
+        if pending is not None:
+            materialize(*pending)
+        pending = (at, nw, c, o)
+    if pending is not None:
+        materialize(*pending)
+    return counts
+
+
+def pad_chunk(s16, d16, nvalid, at: int, hi: int, max_w: int, eb: int):
+    """Compact form of seg_ops.pad_window_chunk: slice [at:hi] and pad
+    the window axis to a power-of-two bucket with empty (count-0)
+    rows. Returns (s16, d16, nvalid, n)."""
+    from . import segment as seg_ops
+
+    n = hi - at
+    wb = min(seg_ops.bucket_size(n), max_w)
+    if n == wb:  # steady state: zero-copy views
+        return s16[at:hi], d16[at:hi], nvalid[at:hi], n
+    sc = np.zeros((wb, eb), np.uint16)
+    dc = np.zeros((wb, eb), np.uint16)
+    nv = np.zeros(wb, np.int32)
+    sc[:n], dc[:n], nv[:n] = s16[at:hi], d16[at:hi], nvalid[at:hi]
+    return sc, dc, nv, n

@@ -1,0 +1,138 @@
+"""Compact-ingress parity: the 4-bytes/slot uint16+counts wire format
+(ops/compact_ingress.py) must reconstruct EXACTLY the arrays the
+standard 9-bytes/slot format ships, and the compact stream program
+must produce identical window counts — including ragged tails, empty
+windows, hub-overflow recounts, and the id boundary at 65535."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.ops import compact_ingress
+from gelly_streaming_tpu.ops import segment as seg_ops
+from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+
+def _stream(n, v, seed):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, v, n).astype(np.int32)
+    dst = rng.integers(0, v, n).astype(np.int32)
+    keep = src != dst
+    return src[keep], dst[keep]
+
+
+def _reconstruct(s16, d16, nvalid, eb, vb):
+    """Host-side mirror of the device widen/mask rebuild."""
+    pos = np.arange(eb)[None, :]
+    valid = pos < nvalid[:, None]
+    s = np.where(valid, s16.astype(np.int64), vb).astype(np.int32)
+    d = np.where(valid, d16.astype(np.int64), vb).astype(np.int32)
+    return s, d, valid
+
+
+@pytest.mark.parametrize("n,eb", [(100, 64), (257, 64), (64, 64),
+                                  (1, 64), (4096, 512)])
+def test_window_stack_parity(n, eb):
+    vb = 256
+    src, dst = _stream(n, vb, seed=n)
+    num_w_std, s_std, d_std, v_std = seg_ops.window_stack(
+        src, dst, eb, sentinel=vb)
+    num_w, s16, d16, nvalid = compact_ingress.window_stack(src, dst, eb)
+    assert num_w == num_w_std
+    s, d, valid = _reconstruct(s16, d16, nvalid, eb, vb)
+    np.testing.assert_array_equal(s, s_std)
+    np.testing.assert_array_equal(d, d_std)
+    np.testing.assert_array_equal(valid, v_std)
+
+
+def test_stack_window_list_parity():
+    vb = 512
+    rng = np.random.default_rng(3)
+    windows = []
+    for k in (0, 1, 17, 64):
+        ws = rng.integers(0, vb, k).astype(np.int32)
+        wd = rng.integers(0, vb, k).astype(np.int32)
+        windows.append((ws, wd))
+    s_std, d_std, v_std = seg_ops.stack_window_list(windows, 64,
+                                                    sentinel=vb)
+    s16, d16, nvalid = compact_ingress.stack_window_list(windows, 64)
+    s, d, valid = _reconstruct(s16, d16, nvalid, 64, vb)
+    np.testing.assert_array_equal(s, s_std)
+    np.testing.assert_array_equal(d, d_std)
+    np.testing.assert_array_equal(valid, v_std)
+
+
+def test_stack_window_list_oversize_raises():
+    with pytest.raises(ValueError):
+        compact_ingress.stack_window_list(
+            [(np.zeros(65, np.int32), np.zeros(65, np.int32))], 64)
+
+
+def test_pad_chunk_parity():
+    vb, eb, n = 128, 32, 517
+    src, dst = _stream(n, vb, seed=9)
+    _, s_std, d_std, v_std = seg_ops.window_stack(src, dst, eb,
+                                                  sentinel=vb)
+    num_w, s16, d16, nvalid = compact_ingress.window_stack(src, dst, eb)
+    for at, hi, max_w in [(0, 8, 8), (8, num_w, 8), (0, num_w, 32),
+                          (0, 3, 8)]:
+        hi = min(hi, num_w)
+        sc_s, dc_s, vc_s, n_s = seg_ops.pad_window_chunk(
+            s_std, d_std, v_std, at, hi, max_w, eb, vb)
+        sc, dc, nv, n_c = compact_ingress.pad_chunk(
+            s16, d16, nvalid, at, hi, max_w, eb)
+        assert n_c == n_s
+        s, d, valid = _reconstruct(sc, dc, nv, eb, vb)
+        np.testing.assert_array_equal(s, sc_s)
+        np.testing.assert_array_equal(d, dc_s)
+        np.testing.assert_array_equal(valid, vc_s)
+
+
+def test_supports_boundary():
+    assert compact_ingress.supports(65536)
+    assert compact_ingress.supports(4)
+    assert not compact_ingress.supports(65537)
+    assert not compact_ingress.supports(1 << 20)
+
+
+def test_compact_stream_counts_match_device_path():
+    """End-to-end: the compact program's counts == the standard device
+    path's counts == the escalating per-window kernel, on a stream
+    sized to produce ragged tails and nonzero triangles."""
+    import jax
+    import jax.numpy as jnp
+
+    vb, eb, n = 128, 256, 2400  # 10 windows, ragged tail of 96? (2400=9*256+96)
+    src, dst = _stream(n, vb, seed=21)
+    kernel = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    std = kernel._count_stream_device(src, dst)
+
+    run = jax.jit(compact_ingress.build_stream_fn(
+        kernel._fns[kernel.kb], kernel.vb, kernel.eb))
+    counts = compact_ingress.run_stack(kernel, run, src, dst)
+    assert counts == std
+    # cross-check against the per-window escalating path
+    per_window = [
+        kernel.count(src[s:s + kernel.eb], dst[s:s + kernel.eb])
+        for s in range(0, len(src), kernel.eb)
+    ]
+    assert counts == per_window
+
+
+def test_compact_stream_id_65535():
+    """The top uint16 id must survive the round trip (padded slots use
+    0 + mask, NOT a u16 sentinel, so 65535 stays a real id)."""
+    import jax
+    import jax.numpy as jnp
+
+    vb = 65536
+    eb = 64
+    # a triangle among the three highest representable ids
+    src = np.array([65535, 65534, 65533], np.int32)
+    dst = np.array([65534, 65533, 65535], np.int32)
+    kernel = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    run = jax.jit(compact_ingress.build_stream_fn(
+        kernel._fns[kernel.kb], kernel.vb, kernel.eb))
+    num_w, s16, d16, nvalid = compact_ingress.window_stack(src, dst, eb)
+    c, o = run(jnp.asarray(s16), jnp.asarray(d16), jnp.asarray(nvalid))
+    assert int(np.array(o)[0]) == 0
+    assert int(np.array(c)[0]) == 1

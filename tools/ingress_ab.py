@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Ingress A/B: is the streaming window counter's end-to-end rate
+bound by h2d transfer, per-dispatch latency, or device compute — and
+does a compact ingress format fix it?
+
+The standard stream dispatch (TriangleWindowKernel._run_stack) ships
+9 bytes per edge-slot h2d: src int32 + dst int32 + valid bool. But
+(a) vertex ids fit uint16 whenever vertex_bucket <= 65536 (every
+bench scale), and (b) padding is always a per-window SUFFIX
+(seg_ops.window_stack), so the [wb, eb] bool mask is reconstructible
+from one int32 count per window. Compact ingress sends
+uint16 src + uint16 dst + int32 nvalid[wb] = 4 bytes/slot (2.25x
+fewer bytes), widening + mask reconstruction fused into the same
+window program on device (VPU-cheap).
+
+Three probes, each a JSON line:
+  h2d_probe      — device_put bandwidth at both formats (bytes/s)
+  latency_probe  — round-trip of a minimal 1-window dispatch (s)
+  stream_ab      — full 10.5M-edge stream end-to-end, standard vs
+                   compact, identical counts asserted window-by-window
+
+Run AFTER the evidence queue (tools/tpu_queue.sh) — it shares the
+tunnel and the single host core. Results go to stdout and
+logs/ingress_ab_<backend>.json; the kernel only ADOPTS compact
+ingress behind the same committed-evidence policy as every other
+selection (ops/triangles.py docstrings).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from bench import make_stream  # noqa: E402  (the A/B stream IS the bench stream)
+
+
+def _median_time(fn, reps=5, warmup=1):
+    for _ in range(warmup):
+        fn()
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
+
+
+def h2d_probe(jax, jnp, eb, wb, results):
+    """device_put bandwidth of one stream chunk in each format."""
+    slots = wb * eb
+    rng = np.random.default_rng(0)
+    s32 = rng.integers(0, 65536, (wb, eb)).astype(np.int32)
+    d32 = rng.integers(0, 65536, (wb, eb)).astype(np.int32)
+    v8 = np.ones((wb, eb), bool)
+    s16 = s32.astype(np.uint16)
+    d16 = d32.astype(np.uint16)
+    nv = np.full(wb, eb, np.int32)
+
+    def put(*arrs):
+        out = [jax.device_put(a) for a in arrs]
+        jax.block_until_ready(out)
+
+    t_std = _median_time(lambda: put(s32, d32, v8))
+    t_cmp = _median_time(lambda: put(s16, d16, nv))
+    row = {
+        "probe": "h2d",
+        "backend": jax.default_backend(),
+        "chunk_slots": slots,
+        "std_bytes": slots * 9,
+        "std_s": round(t_std, 6),
+        "std_bytes_per_s": round(slots * 9 / t_std),
+        "compact_bytes": slots * 4 + 4 * wb,
+        "compact_s": round(t_cmp, 6),
+        "compact_bytes_per_s": round((slots * 4 + 4 * wb) / t_cmp),
+        "speedup": round(t_std / t_cmp, 2),
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def latency_probe(jax, jnp, results):
+    """Fixed round-trip cost of a minimal dispatch (scalar in/out)."""
+    one = jnp.ones((8,), jnp.int32)
+
+    @jax.jit
+    def tick(x):
+        return x.sum()
+
+    t = _median_time(lambda: jax.block_until_ready(tick(one)), reps=9)
+    row = {"probe": "dispatch_latency",
+           "backend": jax.default_backend(), "round_trip_s": round(t, 6)}
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def build_compact_stream(kernel, jax, jnp):
+    """The compact-ingress twin of the kernel's stream program — the
+    exact module form the kernel adopts on winning evidence
+    (ops/compact_ingress.build_stream_fn)."""
+    from gelly_streaming_tpu.ops import compact_ingress
+
+    return jax.jit(compact_ingress.build_stream_fn(
+        kernel._fns[kernel.kb], kernel.vb, kernel.eb))
+
+
+def compact_count_stream(kernel, run, src, dst, jax, jnp):
+    """The compact chunk loop — the SAME code the kernel adopts
+    (ops/compact_ingress.run_stack), not a tool-local copy."""
+    from gelly_streaming_tpu.ops import compact_ingress
+
+    return compact_ingress.run_stack(kernel, run, src, dst)
+
+
+def stream_ab(jax, jnp, num_edges, results):
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    eb, vb = 32768, 65536
+    src, dst = make_stream(num_edges, vb)
+    kernel = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    kernel.warm_chunks()
+    run_compact = build_compact_stream(kernel, jax, jnp)
+    # warm the compact program at both the full and the tail wb
+    from gelly_streaming_tpu.ops import segment as seg_ops
+
+    num_w = -(-len(src) // eb)
+    for wbu in {min(seg_ops.bucket_size(num_w), kernel.MAX_STREAM_WINDOWS),
+                kernel.MAX_STREAM_WINDOWS}:
+        z16 = jnp.zeros((wbu, eb), jnp.uint16)
+        jax.block_until_ready(run_compact(z16, z16,
+                                          jnp.zeros(wbu, jnp.int32)))
+
+    counts_std = counts_cmp = None
+
+    def run_std():
+        nonlocal counts_std
+        counts_std = kernel._count_stream_device(src, dst)
+
+    def run_cmp():
+        nonlocal counts_cmp
+        counts_cmp = compact_count_stream(kernel, run_compact, src, dst,
+                                          jax, jnp)
+
+    t_std = _median_time(run_std, reps=3, warmup=1)
+    t_cmp = _median_time(run_cmp, reps=3, warmup=1)
+    assert counts_std == counts_cmp, "parity failure between ingress forms"
+    row = {
+        "probe": "stream_ab",
+        "backend": jax.default_backend(),
+        "num_edges": len(src),
+        "eb": eb, "k": kernel.kb,
+        "windows_per_dispatch": kernel.MAX_STREAM_WINDOWS,
+        "std_s": round(t_std, 3),
+        "std_edges_per_s": round(len(src) / t_std),
+        "compact_s": round(t_cmp, 3),
+        "compact_edges_per_s": round(len(src) / t_cmp),
+        "speedup": round(t_std / t_cmp, 3),
+        "parity": True,
+    }
+    results.append(row)
+    print(json.dumps(row), flush=True)
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--edges", type=int,
+                    default=int(os.environ.get("GS_AB_EDGES", 10_485_760)))
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    results = []
+    latency_probe(jax, jnp, results)
+    h2d_probe(jax, jnp, 32768, 16, results)
+    stream_ab(jax, jnp, args.edges, results)
+    out = os.path.join(REPO, "logs",
+                       "ingress_ab_%s.json" % jax.default_backend())
+    with open(out, "w") as f:
+        json.dump(results, f, indent=1)
+    print("wrote %s" % out, flush=True)
+
+
+if __name__ == "__main__":
+    main()
