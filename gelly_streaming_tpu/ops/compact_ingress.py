@@ -91,42 +91,6 @@ def stack_window_list(windows, eb: int):
     return s16, d16, nvalid
 
 
-def run_stack(kernel, run, src, dst):
-    """The compact-format twin of TriangleWindowKernel._run_stack —
-    the ONE place the depth-2 pipelined chunk loop + hub-overflow
-    recount policy exists for compact ingress (the A/B tool and the
-    parity tests both call this, so the measured form IS the adopted
-    form). `run` is the compiled build_stream_fn program."""
-    import jax.numpy as jnp
-    import numpy as np
-
-    eb = kernel.eb
-    max_w = kernel.MAX_STREAM_WINDOWS
-    num_w, s16, d16, nvalid = window_stack(src, dst, eb)
-
-    counts = []
-    pending = None
-
-    def materialize(at, nw, c_dev, o_dev):
-        c, o = np.array(c_dev)[:nw], np.array(o_dev)[:nw]
-        for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
-            lo = (at + int(w)) * eb
-            c[w] = kernel.count(src[lo:lo + eb], dst[lo:lo + eb],
-                                min_k=kernel.kb)
-        counts.extend(int(x) for x in c)
-
-    for at in range(0, num_w, max_w):
-        hi = min(at + max_w, num_w)
-        sc, dc, nv, nw = pad_chunk(s16, d16, nvalid, at, hi, max_w, eb)
-        c, o = run(jnp.asarray(sc), jnp.asarray(dc), jnp.asarray(nv))
-        if pending is not None:
-            materialize(*pending)
-        pending = (at, nw, c, o)
-    if pending is not None:
-        materialize(*pending)
-    return counts
-
-
 def pad_chunk(s16, d16, nvalid, at: int, hi: int, max_w: int, eb: int):
     """Compact form of seg_ops.pad_window_chunk: slice [at:hi] and pad
     the window axis to a power-of-two bucket with empty (count-0)

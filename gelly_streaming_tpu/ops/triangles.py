@@ -448,6 +448,48 @@ def _resolve_stream_impl() -> str:
     return impl
 
 
+_INGRESS = None   # "standard" | "compact", resolved once per process
+
+
+def _reset_ingress() -> None:
+    """Test hook: forget the memoized ingress selection."""
+    global _INGRESS
+    _INGRESS = None
+
+
+def resolve_ingress(vb: int) -> str:
+    """Stream-chunk wire format: "standard" (int32 ids + bool mask,
+    9 bytes/slot) or "compact" (uint16 ids + per-window valid counts,
+    4 bytes/slot; ops/compact_ingress.py). The chip's end-to-end
+    stream rate is h2d-transfer bound (PERF.md "VERIFIED chip rows"),
+    so the format is a measured selection like the kernels: compact
+    only when (a) ids fit uint16 for THIS vertex bucket and (b) the
+    committed backend-matched `ingress_ab` rows (tools/ingress_ab.py
+    via tools/profile_kernels.py) all show parity and a ≥5%
+    end-to-end win. Memoized per process (reset: _reset_ingress);
+    the vb gate applies per kernel instance."""
+    global _INGRESS
+    if _INGRESS is None:
+        impl = "standard"
+        try:
+            perf = _load_matching_perf()
+            rows = (perf or {}).get("ingress_ab", [])
+            if (isinstance(rows, list) and rows
+                    and all(r.get("parity") is True
+                            and (r.get("speedup") or 0) >= 1.05
+                            for r in rows)):
+                impl = "compact"
+        except Exception:
+            pass
+        _INGRESS = impl
+    if _INGRESS == "compact":
+        from . import compact_ingress
+
+        if not compact_ingress.supports(vb):
+            return "standard"
+    return _INGRESS
+
+
 _TUNED_KB = {}  # eb -> measured starting K (resolved once per process)
 
 
@@ -575,7 +617,7 @@ class TriangleWindowKernel:
     MAX_STREAM_WINDOWS = 64  # windows per dispatch in count_stream
 
     def __init__(self, edge_bucket: int, vertex_bucket: int,
-                 k_bucket: int = 0):
+                 k_bucket: int = 0, ingress: str = None):
         self.eb = seg_ops.bucket_size(edge_bucket)
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.kb = seg_ops.bucket_size(
@@ -584,6 +626,17 @@ class TriangleWindowKernel:
         # instance attribute shadows the class default when a committed
         # chunk sweep exists for this bucket on this backend
         self.MAX_STREAM_WINDOWS = _tuned_chunk(self.eb)
+        # wire format of stream-chunk dispatches; explicit `ingress`
+        # pins a format (the A/B tool measures both), None resolves
+        # from committed evidence
+        if ingress == "compact":
+            from . import compact_ingress
+
+            if not compact_ingress.supports(self.vb):
+                raise ValueError(
+                    "compact ingress is lossy for vertex_bucket %d "
+                    "(ids must fit uint16)" % self.vb)
+        self.ingress = ingress if ingress else resolve_ingress(self.vb)
         self._fns = {self.kb: self._build(self.kb)}
         self._stream_fns = {}
         self._stream_execs = {}
@@ -638,30 +691,46 @@ class TriangleWindowKernel:
 
     def _stream_exec(self, wb: int):
         """AOT-compiled stream program for a [wb, eb] chunk at the
-        current K, in the kernel's OWN cache: warming via
-        .lower().compile() never executes anything (jit's internal
-        shape cache is not populated by AOT compilation, so the
-        dispatch path must share this cache for compile-only warming
-        to stick)."""
-        key = (self.kb, wb)
+        current K and ingress format, in the kernel's OWN cache:
+        warming via .lower().compile() never executes anything (jit's
+        internal shape cache is not populated by AOT compilation, so
+        the dispatch path must share this cache for compile-only
+        warming to stick)."""
+        key = (self.kb, wb, self.ingress)
         ex = self._stream_execs.get(key)
         if ex is None:
-            if self.kb not in self._stream_fns:
-                self._stream_fns[self.kb] = self._build_stream(self.kb)
-            sds_i = jax.ShapeDtypeStruct((wb, self.eb), jnp.int32)
-            sds_b = jax.ShapeDtypeStruct((wb, self.eb), jnp.bool_)
-            ex = self._stream_fns[self.kb].lower(
-                sds_i, sds_i, sds_b).compile()
+            fkey = (self.kb, self.ingress)
+            if fkey not in self._stream_fns:
+                if self.ingress == "compact":
+                    from . import compact_ingress
+
+                    self._stream_fns[fkey] = jax.jit(
+                        compact_ingress.build_stream_fn(
+                            self._fns[self.kb], self.vb, self.eb))
+                else:
+                    self._stream_fns[fkey] = self._build_stream(self.kb)
+            if self.ingress == "compact":
+                sds_u = jax.ShapeDtypeStruct((wb, self.eb), jnp.uint16)
+                sds_n = jax.ShapeDtypeStruct((wb,), jnp.int32)
+                ex = self._stream_fns[fkey].lower(
+                    sds_u, sds_u, sds_n).compile()
+            else:
+                sds_i = jax.ShapeDtypeStruct((wb, self.eb), jnp.int32)
+                sds_b = jax.ShapeDtypeStruct((wb, self.eb), jnp.bool_)
+                ex = self._stream_fns[fkey].lower(
+                    sds_i, sds_i, sds_b).compile()
             self._stream_execs[key] = ex
         return ex
 
-    def _run_stack(self, s, d, valid, get_window) -> list:
-        """Dispatch a [W, eb] window stack in MAX_STREAM_WINDOWS chunks;
-        `get_window(w)` returns the raw (src, dst) of window w for the
-        rare exact overflow recount. The window axis of a ragged final
-        chunk pads to a power-of-two bucket (all-invalid rows), so
-        varying stream lengths reuse O(log MAX_STREAM_WINDOWS) compiled
-        programs instead of one per distinct tail length.
+
+    def _run_stack_loop(self, num_w: int, make_chunk, recount) -> list:
+        """The ONE depth-2 pipelined chunk loop both wire formats run.
+        `make_chunk(at, hi)` returns (args_tuple, n) — the padded
+        device arguments for windows [at:hi] plus the real window
+        count (the window axis of a ragged final chunk pads to a
+        power-of-two bucket, so varying stream lengths reuse
+        O(log MAX_STREAM_WINDOWS) compiled programs); `recount(w)`
+        exactly recounts window w when its hubs overflow K.
 
         Dispatch is PIPELINED depth 2: jax enqueues asynchronously, so
         the host pads + enqueues chunk i+1 while the device runs chunk
@@ -669,7 +738,6 @@ class TriangleWindowKernel:
         overlap instead of pad→run→block→pad serialization (the d2h of
         counts is tiny; the win is hiding host prep + dispatch latency
         behind device compute)."""
-        num_w = s.shape[0]
         counts: list = []
         pending = None  # (at, n, c_dev, o_dev)
 
@@ -677,23 +745,49 @@ class TriangleWindowKernel:
             # np.array (not asarray): device outputs can be read-only
             c, o = np.array(c_dev)[:n], np.array(o_dev)[:n]
             for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
-                ws, wd = get_window(at + int(w))
-                c[w] = self.count(ws, wd, min_k=self.kb)
+                c[w] = recount(at + int(w))
             counts.extend(int(x) for x in c)
 
         for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            sc, dc, vc, n = seg_ops.pad_window_chunk(
-                s, d, valid, at, hi, self.MAX_STREAM_WINDOWS, self.eb,
-                self.vb)
-            c, o = self._stream_exec(sc.shape[0])(
-                jnp.asarray(sc), jnp.asarray(dc), jnp.asarray(vc))
+            args, n = make_chunk(at, hi)
+            c, o = self._stream_exec(args[0].shape[0])(
+                *[jnp.asarray(a) for a in args])
             if pending is not None:
                 materialize(*pending)
             pending = (at, n, c, o)
         if pending is not None:
             materialize(*pending)
         return counts
+
+    def _run_stack(self, s, d, valid, get_window) -> list:
+        """Standard-format window stack through _run_stack_loop."""
+
+        def make_chunk(at, hi):
+            sc, dc, vc, n = seg_ops.pad_window_chunk(
+                s, d, valid, at, hi, self.MAX_STREAM_WINDOWS, self.eb,
+                self.vb)
+            return (sc, dc, vc), n
+
+        def recount(w):
+            ws, wd = get_window(w)
+            return self.count(ws, wd, min_k=self.kb)
+
+        return self._run_stack_loop(s.shape[0], make_chunk, recount)
+
+    def _run_stack_compact(self, num_w, s16, d16, nvalid,
+                           recount) -> list:
+        """Compact-format stacks (ops/compact_ingress prep) through
+        the SAME _run_stack_loop."""
+        from . import compact_ingress
+
+        def make_chunk(at, hi):
+            sc, dc, nv, n = compact_ingress.pad_chunk(
+                s16, d16, nvalid, at, hi, self.MAX_STREAM_WINDOWS,
+                self.eb)
+            return (sc, dc, nv), n
+
+        return self._run_stack_loop(num_w, make_chunk, recount)
 
     def warm_chunks(self) -> None:
         """Compile every stream-chunk program _run_stack can dispatch
@@ -740,9 +834,19 @@ class TriangleWindowKernel:
                              dst: np.ndarray) -> list:
         """The device path of count_stream, selection bypassed (the
         profiler measures both tiers through this split)."""
+        eb = self.eb
+        if self.ingress == "compact":
+            from . import compact_ingress
+
+            num_w, s16, d16, nv = compact_ingress.window_stack(
+                src, dst, eb)
+            return self._run_stack_compact(
+                num_w, s16, d16, nv,
+                lambda w: self.count(src[w * eb:(w + 1) * eb],
+                                     dst[w * eb:(w + 1) * eb],
+                                     min_k=self.kb))
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
                                                   sentinel=self.vb)
-        eb = self.eb
         return self._run_stack(
             s, d, valid,
             lambda w: (src[w * eb:(w + 1) * eb], dst[w * eb:(w + 1) * eb]))
@@ -774,6 +878,14 @@ class TriangleWindowKernel:
             from . import host_triangles
 
             return host_triangles.count_windows(windows)
+        if self.ingress == "compact":
+            from . import compact_ingress
+
+            s16, d16, nv = compact_ingress.stack_window_list(
+                windows, self.eb)
+            return self._run_stack_compact(
+                len(windows), s16, d16, nv,
+                lambda w: self.count(*windows[w], min_k=self.kb))
         s, d, valid = seg_ops.stack_window_list(windows, self.eb,
                                                 self.vb)
         return self._run_stack(s, d, valid, lambda w: windows[w])

@@ -94,28 +94,45 @@ def test_supports_boundary():
     assert not compact_ingress.supports(1 << 20)
 
 
-def test_compact_stream_counts_match_device_path():
+def test_compact_pin_rejects_wide_vertex_bucket():
+    """An explicit compact pin with ids wider than uint16 must be an
+    ERROR, not a silent id-wrapping miscount."""
+    with pytest.raises(ValueError):
+        TriangleWindowKernel(edge_bucket=256, vertex_bucket=1 << 17,
+                             ingress="compact")
+
+
+def test_compact_stream_counts_match_device_path(monkeypatch):
     """End-to-end: the compact program's counts == the standard device
     path's counts == the escalating per-window kernel, on a stream
     sized to produce ragged tails and nonzero triangles."""
-    import jax
-    import jax.numpy as jnp
+    from gelly_streaming_tpu.ops import triangles as tri_mod
 
-    vb, eb, n = 128, 256, 2400  # 10 windows, ragged tail of 96? (2400=9*256+96)
+    # pin the device tier: count_windows must exercise the compact
+    # DEVICE path even where committed CPU evidence selects a host tier
+    monkeypatch.setattr(tri_mod, "_STREAM_IMPL", "device")
+
+    vb, eb, n = 128, 256, 2400  # 10 windows with a 96-edge ragged tail
     src, dst = _stream(n, vb, seed=21)
-    kernel = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    # the baseline is PINNED standard: committed winning ingress_ab
+    # rows must not silently turn this into compact-vs-compact
+    kernel = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                  ingress="standard")
     std = kernel._count_stream_device(src, dst)
 
-    run = jax.jit(compact_ingress.build_stream_fn(
-        kernel._fns[kernel.kb], kernel.vb, kernel.eb))
-    counts = compact_ingress.run_stack(kernel, run, src, dst)
-    assert counts == std
+    # the kernel's integrated compact path, on both dispatch surfaces
+    k_cmp = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                 ingress="compact")
+    assert k_cmp._count_stream_device(src, dst) == std
+    windows = [(src[s:s + eb], dst[s:s + eb])
+               for s in range(0, len(src), eb)]
+    assert k_cmp.count_windows(windows) == std
     # cross-check against the per-window escalating path
     per_window = [
         kernel.count(src[s:s + kernel.eb], dst[s:s + kernel.eb])
         for s in range(0, len(src), kernel.eb)
     ]
-    assert counts == per_window
+    assert std == per_window
 
 
 def test_compact_stream_id_65535():

@@ -34,6 +34,7 @@ def selection_env(tmp_path, monkeypatch):
     monkeypatch.setattr(triangles, "_TUNED_KB", {})
     monkeypatch.setattr(triangles, "_TUNED_CHUNK", {})
     monkeypatch.setattr(triangles, "_STREAM_IMPL", None)
+    monkeypatch.setattr(triangles, "_INGRESS", None)
 
     def configure(file_backend, process_backend, **sections):
         perf_path.write_text(
@@ -76,6 +77,39 @@ def test_intersect_on_cpu_stays_bsearch_despite_chip_rows(selection_env):
     selection_env("tpu", "cpu", intersect=INTERSECT_WIN)
     assert (triangles.resolve_intersect_impl()
             is triangles.intersect_local_bsearch)
+
+
+INGRESS_WIN = [{"probe": "stream_ab", "parity": True, "speedup": 1.31}]
+
+
+def test_ingress_flips_to_compact_on_winning_rows(selection_env):
+    selection_env("tpu", "tpu", ingress_ab=INGRESS_WIN)
+    assert triangles.resolve_ingress(65536) == "compact"
+
+
+@pytest.mark.parametrize("rows", [
+    [{"parity": True, "speedup": 1.02}],   # < 5% win
+    [{"parity": False, "speedup": 9.9}],   # no parity
+    [],                                    # no data
+    [{"parity": True, "speedup": 1.31},
+     {"parity": True, "speedup": 0.98}],   # must win at EVERY row
+])
+def test_ingress_stays_standard_without_a_clean_win(selection_env, rows):
+    selection_env("tpu", "tpu", ingress_ab=rows)
+    assert triangles.resolve_ingress(65536) == "standard"
+
+
+def test_ingress_vb_gate_overrides_winning_rows(selection_env):
+    # ids wider than uint16: the format is lossy there, never selected
+    selection_env("tpu", "tpu", ingress_ab=INGRESS_WIN)
+    assert triangles.resolve_ingress(1 << 17) == "standard"
+    # the memoized win still applies to buckets that DO fit
+    assert triangles.resolve_ingress(32768) == "compact"
+
+
+def test_ingress_ignores_other_backend_rows(selection_env):
+    selection_env("cpu", "tpu", ingress_ab=INGRESS_WIN)
+    assert triangles.resolve_ingress(65536) == "standard"
 
 
 def test_dense_flips_to_pallas_and_doubles_limit(selection_env):

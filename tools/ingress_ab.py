@@ -98,52 +98,30 @@ def latency_probe(jax, jnp, results):
     print(json.dumps(row), flush=True)
 
 
-def build_compact_stream(kernel, jax, jnp):
-    """The compact-ingress twin of the kernel's stream program — the
-    exact module form the kernel adopts on winning evidence
-    (ops/compact_ingress.build_stream_fn)."""
-    from gelly_streaming_tpu.ops import compact_ingress
-
-    return jax.jit(compact_ingress.build_stream_fn(
-        kernel._fns[kernel.kb], kernel.vb, kernel.eb))
-
-
-def compact_count_stream(kernel, run, src, dst, jax, jnp):
-    """The compact chunk loop — the SAME code the kernel adopts
-    (ops/compact_ingress.run_stack), not a tool-local copy."""
-    from gelly_streaming_tpu.ops import compact_ingress
-
-    return compact_ingress.run_stack(kernel, run, src, dst)
-
-
 def stream_ab(jax, jnp, num_edges, results):
+    """Both ingress formats through the kernel's OWN adopted dispatch
+    path (TriangleWindowKernel(ingress=...)._count_stream_device), so
+    the measured forms are exactly the shipping ones."""
     from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
 
     eb, vb = 32768, 65536
     src, dst = make_stream(num_edges, vb)
-    kernel = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
-    kernel.warm_chunks()
-    run_compact = build_compact_stream(kernel, jax, jnp)
-    # warm the compact program at both the full and the tail wb
-    from gelly_streaming_tpu.ops import segment as seg_ops
-
-    num_w = -(-len(src) // eb)
-    for wbu in {min(seg_ops.bucket_size(num_w), kernel.MAX_STREAM_WINDOWS),
-                kernel.MAX_STREAM_WINDOWS}:
-        z16 = jnp.zeros((wbu, eb), jnp.uint16)
-        jax.block_until_ready(run_compact(z16, z16,
-                                          jnp.zeros(wbu, jnp.int32)))
+    k_std = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                 ingress="standard")
+    k_cmp = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                 ingress="compact")
+    k_std.warm_chunks()
+    k_cmp.warm_chunks()
 
     counts_std = counts_cmp = None
 
     def run_std():
         nonlocal counts_std
-        counts_std = kernel._count_stream_device(src, dst)
+        counts_std = k_std._count_stream_device(src, dst)
 
     def run_cmp():
         nonlocal counts_cmp
-        counts_cmp = compact_count_stream(kernel, run_compact, src, dst,
-                                          jax, jnp)
+        counts_cmp = k_cmp._count_stream_device(src, dst)
 
     t_std = _median_time(run_std, reps=3, warmup=1)
     t_cmp = _median_time(run_cmp, reps=3, warmup=1)
@@ -152,8 +130,8 @@ def stream_ab(jax, jnp, num_edges, results):
         "probe": "stream_ab",
         "backend": jax.default_backend(),
         "num_edges": len(src),
-        "eb": eb, "k": kernel.kb,
-        "windows_per_dispatch": kernel.MAX_STREAM_WINDOWS,
+        "eb": eb, "k": k_std.kb,
+        "windows_per_dispatch": k_std.MAX_STREAM_WINDOWS,
         "std_s": round(t_std, 3),
         "std_edges_per_s": round(len(src) / t_std),
         "compact_s": round(t_cmp, 3),

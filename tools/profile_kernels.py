@@ -850,29 +850,55 @@ print(json.dumps(out))
     return run_json_child([sys.executable, "-c", code], 1800, env=env)
 
 
+def section_ingress_ab(results: dict) -> None:
+    """Stream-chunk wire-format A/B (ops/compact_ingress.py) — the
+    committed evidence `resolve_ingress` reads, via the same probes as
+    the standalone tools/ingress_ab.py. `ingress_ab` carries ONLY the
+    stream A/B rows (the selection gate checks parity+speedup on every
+    row); the latency/bandwidth probes land under `ingress_probes`."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools.ingress_ab import h2d_probe, latency_probe, stream_ab
+
+    probes, ab = [], []
+    latency_probe(jax, jnp, probes)
+    h2d_probe(jax, jnp, 32768, 16, probes)
+    stream_ab(jax, jnp, int(os.environ.get("GS_AB_EDGES", 2_097_152)),
+              ab)
+    results["ingress_probes"] = probes
+    results["ingress_ab"] = ab
+
+
+# Order = run order. The wedge-prone whole-pipeline compiles (fused,
+# driver — both stalled the tunnel's remote compiler >2400s in r04)
+# run LAST so a short tunnel window banks the selection-driving
+# sections before risking a per-section timeout.
 SECTIONS = {
     "intersect": section_intersect,
     "window": section_window,
-    "fused": section_fused,
+    "ingress_ab": section_ingress_ab,
     "dense": section_dense,
-    "driver": section_driver,
     "roofline": section_roofline,
     "trace": section_trace,
     "host_stream": section_host_stream,
     "host_reduce": section_host_reduce,
+    "fused": section_fused,
+    "driver": section_driver,
 }
 
 
 def run_section_child(name: str) -> None:
     """Child mode: run ONE chip section in-process and print its JSON
-    line. The orchestrator owns the timeout; this process just works."""
+    line — the FULL results dict, so auxiliary keys a section records
+    next to its own (e.g. ingress_ab's `ingress_probes`) reach the
+    orchestrator instead of vanishing with the child."""
     import jax
 
     results = {"backend": jax.default_backend(),
                "device": str(jax.devices()[0])}
     SECTIONS[name](results)
-    print(json.dumps({name: results[name], "backend": results["backend"],
-                      "device": results["device"]}), flush=True)
+    print(json.dumps(results), flush=True)
 
 
 def run_section_subprocess(name: str, timeout_s: int, env=None) -> dict:
@@ -1006,6 +1032,12 @@ def main():
                                 {"error": "missing section key"})
         if "error" not in results[name]:
             ok_sections.append(name)
+            # auxiliary keys a section recorded beside its own (e.g.
+            # ingress_ab's `ingress_probes`) ride along into PERF.json
+            for k, v in got.items():
+                if k not in ("backend", "device", name) \
+                        and k not in SECTIONS:
+                    results[k] = v
         print(json.dumps({name: results[name]}), flush=True)
         flush()
     if "sharded" in want:
