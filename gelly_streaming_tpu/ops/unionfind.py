@@ -114,7 +114,8 @@ _cc_fixpoint_jit = jax.jit(cc_fixpoint)
 def connected_components_with_labels(src: np.ndarray, dst: np.ndarray,
                                      labels: np.ndarray,
                                      num_vertices: int,
-                                     vertex_bucket: int = 0) -> np.ndarray:
+                                     vertex_bucket: int = 0,
+                                     edge_bucket: int = 0) -> np.ndarray:
     """Carried-state variant: fold a batch of edges into an existing
     labeling (streaming-iteration semantics, strategy P5). `labels` is a
     dense int32 [num_vertices] forest pointing at equal-or-smaller
@@ -127,9 +128,13 @@ def connected_components_with_labels(src: np.ndarray, dst: np.ndarray,
     per distinct count (a steady-state-recompile bug caught by
     tools/scale_run.py's jax_log_compiles assert in round 2). Callers
     that already hold a grown bucket (the streaming driver) pass it as
-    `vertex_bucket` so every window reuses ONE program."""
+    `vertex_bucket` so every window reuses ONE program; passing
+    `edge_bucket` likewise clamps smaller batches UP to the steady
+    program (a stream's final partial window must not compile a fresh
+    tiny-bucket ladder at the tail — caught by tools/endurance_run.py's
+    steady-state compile assert)."""
     e = len(src)
-    eb = seg_ops.bucket_size(e)
+    eb = seg_ops.bucket_size(max(e, edge_bucket))
     vb = seg_ops.bucket_size(max(num_vertices, vertex_bucket))
     s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)
     d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)
