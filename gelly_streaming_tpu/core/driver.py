@@ -449,9 +449,12 @@ class StreamingAnalyticsDriver:
 
     def _scan_chunk(self) -> int:
         """Windows per snapshot-scan dispatch: _SCAN_CHUNK, compile-
-        size-capped on the tunneled chip (a 2^21-edge stream program
-        wedged the remote compiler; ops/triangles._default_chunk)."""
-        return min(self._SCAN_CHUNK, tri_ops._default_chunk(self.eb))
+        size-capped on the tunneled chip per-PROGRAM (the
+        multi-analytic snapshot scan wedges at sizes the triangle
+        program compiles; ops/triangles.compile_cap
+        "snapshot_scan")."""
+        return min(self._SCAN_CHUNK,
+                   tri_ops.capped_chunk(self.eb, "snapshot_scan"))
 
     def _scan_fn(self, num_w: int):
         """Jitted snapshot scan for the current buckets, cached per

@@ -173,10 +173,13 @@ class StreamSummaryEngine(SummaryEngineBase):
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.kb = seg_ops.bucket_size(
             k_bucket if k_bucket else tri_ops._tuned_kb(self.eb))
-        # compile-size cap on the tunneled chip: a 2^21-edge stream
-        # program wedged the remote compiler (_default_chunk docstring)
+        # compile-size cap on the tunneled chip, per-PROGRAM: the fused
+        # multi-analytic scan wedged the remote compiler even at the
+        # triangle program's clean size, so its cap is probed
+        # separately (tri_ops.compile_cap "fused_scan")
         self.MAX_WINDOWS = min(type(self).MAX_WINDOWS,
-                               tri_ops._default_chunk(self.eb))
+                               tri_ops.capped_chunk(self.eb,
+                                                    "fused_scan"))
         body = _build_scan(self.eb, self.vb, self.kb)
 
         @jax.jit

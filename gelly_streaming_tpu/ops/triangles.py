@@ -542,24 +542,85 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
 _TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch
 
 
-def _default_chunk(eb: int) -> int:
-    """Unmeasured windows-per-dispatch default. On a TPU backend the
-    chunk is capped so the stream program stays ≤ 2^19 edges: both
-    programs the round-4 chip window compiled cleanly sit exactly
-    there (64×8192, 16×32768), while the 64×32768 = 2^21 program
-    wedged the tunnel's remote compiler >25 min twice
-    (logs/bench_r04_stage1.err; round 2 saw the same at 131072-edge
-    windows). Off-chip the sweep is flat, so the class default
-    stands."""
+_COMPILE_CAPS = {}           # program -> slots, resolved once per process
+_COMPILE_CAP_DEFAULT = 1 << 19
+# sizes proven clean OUTSIDE the probe (the round-4 chip window's
+# bench compiles): a probed failure above these never lowers the cap
+# beneath them. The scan programs have no proven size — they wedged
+# at/below the default.
+_PROVEN_CLEAN = {"triangle_stream": 1 << 19}
+
+
+def _reset_compile_caps() -> None:
+    """Test hook: forget the memoized per-program compile caps."""
+    _COMPILE_CAPS.clear()
+
+
+def compile_cap(program: str = "triangle_stream") -> int:
+    """Largest stream-program size (window-slots per dispatch) trusted
+    to COMPILE for `program` on this backend.
+
+    Default 2^19: both triangle stream shapes at that size compiled
+    cleanly in the round-4 chip window (64×8192, 16×32768) while the
+    2^21 one wedged the tunnel's remote compiler >25 min twice
+    (logs/bench_r04_stage1.err) — and the multi-analytic scan programs
+    (fused engine, driver snapshot) wedged even at the default, which
+    is why the cap is per-PROGRAM. Committed backend-matched
+    `compile_probe`/`compile_probe_scan` rows
+    (tools/profile_kernels.py, each candidate compiled in its own
+    hard-timeout subprocess) move it: a clean row RAISES the cap to
+    its size; a probed failure at/below the current cap LOWERS it to
+    the largest clean size beneath the failure (or a quarter of the
+    failing size when none is measured)."""
+    if program in _COMPILE_CAPS:
+        return _COMPILE_CAPS[program]
+    cap = _COMPILE_CAP_DEFAULT
+    try:
+        perf = _load_matching_perf()
+        rows = []
+        for key in ("compile_probe", "compile_probe_scan"):
+            sec = (perf or {}).get(key, [])
+            if isinstance(sec, list):
+                rows += [r for r in sec
+                         if r.get("program") == program]
+        clean = sorted(int(r["slots"]) for r in rows
+                       if r.get("ok") is True and r.get("slots"))
+        failed = sorted(int(r["slots"]) for r in rows
+                        if r.get("ok") is False and r.get("slots"))
+        if clean:
+            cap = max(cap, clean[-1])
+        if failed and failed[0] <= cap:
+            floor = [s for s in clean if s < failed[0]]
+            proven = _PROVEN_CLEAN.get(program)
+            if proven is not None and proven < failed[0]:
+                floor.append(proven)
+            cap = max(floor) if floor else max(1, failed[0] // 4)
+    except Exception:
+        pass
+    _COMPILE_CAPS[program] = cap
+    return cap
+
+
+def capped_chunk(eb: int, program: str) -> int:
+    """Windows-per-dispatch limit for `program` at this edge bucket:
+    the probed compile cap on a TPU backend, the class maximum
+    off-chip (dispatch is ~free there and the host compiler does not
+    wedge)."""
     try:
         import jax as _jax
 
         if _jax.default_backend() == "tpu":
-            return max(1, min(TriangleWindowKernel.MAX_STREAM_WINDOWS,
-                              (1 << 19) // max(eb, 1)))
+            return max(1, compile_cap(program) // max(eb, 1))
     except Exception:
         pass
     return TriangleWindowKernel.MAX_STREAM_WINDOWS
+
+
+def _default_chunk(eb: int) -> int:
+    """Unmeasured windows-per-dispatch default for the triangle stream
+    program (compile-size-capped on TPU backends; compile_cap)."""
+    return max(1, min(TriangleWindowKernel.MAX_STREAM_WINDOWS,
+                      capped_chunk(eb, "triangle_stream")))
 
 
 def _tuned_chunk(eb: int) -> int:

@@ -132,13 +132,15 @@ class WindowedEdgeReduce:
         assert name in (None, "sum", "min", "max"), name
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.eb = seg_ops.bucket_size(edge_bucket)
-        # compile-size cap on the tunneled chip (the bench's reduce leg
-        # timed out in the round-4 window before this cap existed —
-        # ops/triangles._default_chunk has the evidence)
+        # compile-size cap on the tunneled chip: its own program class
+        # (a segment-reduce stack, unproven on the remote compiler) so
+        # a RAISED triangle cap never drags this program past the
+        # default (ops/triangles.compile_cap)
         from . import triangles as _tri
 
-        self.MAX_STREAM_WINDOWS = min(type(self).MAX_STREAM_WINDOWS,
-                                      _tri._default_chunk(self.eb))
+        self.MAX_STREAM_WINDOWS = min(
+            type(self).MAX_STREAM_WINDOWS,
+            _tri.capped_chunk(self.eb, "reduce_stack"))
         self.name = name
         self.fn = fn
         self.direction = direction

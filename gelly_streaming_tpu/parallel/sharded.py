@@ -1051,11 +1051,14 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
             k_bucket=k_bucket)
         self.eb = self._tri.eb
         self.vb = self._tri.vb
-        # same compile-size cap as the single-chip engine (the PER-
-        # DEVICE slice is eb/n, but the tunnel compiles the whole
+        # same compile-size cap as the single-chip FUSED engine — this
+        # is the multi-analytic scan program class that wedges the
+        # remote compiler at sizes the triangle program compiles (the
+        # PER-DEVICE slice is eb/n, but the tunnel compiles the whole
         # program; conservative is cheap here)
         self.MAX_WINDOWS = min(type(self).MAX_WINDOWS,
-                               triangles._default_chunk(self.eb))
+                               triangles.capped_chunk(self.eb,
+                                                      "fused_scan"))
         self._run = make_sharded_summary_scan(
             mesh, self.eb, self.vb, self._tri.kb, self._tri.cap,
             table=self._tri.table)

@@ -35,6 +35,7 @@ def selection_env(tmp_path, monkeypatch):
     monkeypatch.setattr(triangles, "_TUNED_CHUNK", {})
     monkeypatch.setattr(triangles, "_STREAM_IMPL", None)
     monkeypatch.setattr(triangles, "_INGRESS", None)
+    monkeypatch.setattr(triangles, "_COMPILE_CAPS", {})
 
     def configure(file_backend, process_backend, **sections):
         perf_path.write_text(
@@ -110,6 +111,69 @@ def test_ingress_vb_gate_overrides_winning_rows(selection_env):
 def test_ingress_ignores_other_backend_rows(selection_env):
     selection_env("cpu", "tpu", ingress_ab=INGRESS_WIN)
     assert triangles.resolve_ingress(65536) == "standard"
+
+
+def test_compile_cap_raised_by_clean_probe_row(selection_env):
+    selection_env("tpu", "tpu", compile_probe=[
+        {"program": "triangle_stream", "slots": 1 << 20, "ok": True,
+         "compile_s": 41.0}])
+    assert triangles.compile_cap("triangle_stream") == 1 << 20
+    # ...and the chunk selector sees it: 2^20 / 32768 = 32 windows
+    assert triangles._default_chunk(32768) == 32
+
+
+def test_compile_cap_lowered_by_probed_failure(selection_env):
+    selection_env("tpu", "tpu", compile_probe_scan=[
+        {"program": "fused_scan", "slots": 1 << 19, "ok": False,
+         "reason": "timeout"},
+        {"program": "fused_scan", "slots": 1 << 17, "ok": True,
+         "compile_s": 30.0}])
+    assert triangles.compile_cap("fused_scan") == 1 << 17
+    # no clean row below the failure: quarter of the failing size
+    triangles._reset_compile_caps()
+    selection_env("tpu", "tpu", compile_probe_scan=[
+        {"program": "snapshot_scan", "slots": 1 << 18, "ok": False,
+         "reason": "timeout"}])
+    assert triangles.compile_cap("snapshot_scan") == 1 << 16
+
+
+def test_compile_cap_failure_above_proven_size_keeps_the_default(
+        selection_env):
+    # a 2^20 triangle wedge must not drag the cap below 2^19 — that
+    # size compiled clean in the round-4 chip window (the quarter
+    # fallback applies only to programs with NO proven size)
+    selection_env("tpu", "tpu", compile_probe=[
+        {"program": "triangle_stream", "slots": 1 << 20, "ok": False,
+         "reason": "timeout"}])
+    assert triangles.compile_cap("triangle_stream") == 1 << 19
+
+
+def test_compile_cap_ignores_inconclusive_rows(selection_env):
+    # ok=None (crash / tunnel flake, not a timed-out compile) moves
+    # nothing in either direction
+    selection_env("tpu", "tpu", compile_probe_scan=[
+        {"program": "fused_scan", "slots": 1 << 17, "ok": None,
+         "reason": "backend cpu"}])
+    assert triangles.compile_cap("fused_scan") == 1 << 19
+
+
+def test_compile_cap_ignores_other_backend_and_programs(selection_env):
+    selection_env("cpu", "tpu", compile_probe=[
+        {"program": "triangle_stream", "slots": 1 << 20, "ok": True}])
+    assert triangles.compile_cap("triangle_stream") == 1 << 19
+    triangles._reset_compile_caps()
+    selection_env("tpu", "tpu", compile_probe=[
+        {"program": "triangle_stream", "slots": 1 << 20, "ok": True}])
+    # another program's rows never move this program's cap
+    assert triangles.compile_cap("fused_scan") == 1 << 19
+
+
+def test_capped_chunk_unlimited_off_chip(selection_env):
+    selection_env("cpu", "cpu", compile_probe_scan=[
+        {"program": "fused_scan", "slots": 1 << 17, "ok": False}])
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+    assert (triangles.capped_chunk(32768, "fused_scan")
+            == TriangleWindowKernel.MAX_STREAM_WINDOWS)
 
 
 def test_dense_flips_to_pallas_and_doubles_limit(selection_env):

@@ -870,10 +870,122 @@ def section_ingress_ab(results: dict) -> None:
     results["ingress_ab"] = ab
 
 
-# Order = run order. The wedge-prone whole-pipeline compiles (fused,
-# driver — both stalled the tunnel's remote compiler >2400s in r04)
-# run LAST so a short tunnel window banks the selection-driving
-# sections before risking a per-section timeout.
+PROBE_TIMEOUT_S = int(os.environ.get("GS_PROBE_TIMEOUT", "420"))
+
+# Candidate stream programs for the per-program compile caps
+# (ops/triangles.compile_cap). Triangle candidates try to RAISE the
+# 2^19 default (the chip chunk sweep was still climbing at the cap);
+# scan candidates BISECT the fused/snapshot wedge (both programs
+# stalled the remote compiler >2400s at sizes the triangle program
+# compiles cleanly).
+PROBE_CANDIDATES = {
+    "compile_probe": [
+        ("triangle_stream", 32_768, 32),   # 2^20
+        ("triangle_stream", 8_192, 128),   # 2^20
+    ],
+    "compile_probe_scan": [
+        ("fused_scan", 8_192, 16),         # 2^17
+        ("fused_scan", 32_768, 16),        # 2^19 (the wedged shape?)
+        ("snapshot_scan", 8_192, 16),      # 2^17
+        ("snapshot_scan", 8_192, 32),      # 2^18 (the r04 driver shape)
+    ],
+}
+
+
+def run_compile_probe_child(program: str, eb: int, wb: int) -> None:
+    """Compile (and for the scan programs, run once on a trivial
+    stream) ONE candidate shape, overriding the memoized cap so the
+    shape under test is actually built. Prints a single probe row;
+    the orchestrating section's subprocess timeout converts a wedged
+    remote compile into an ok=false row instead of a lost stage."""
+    import jax
+
+    import numpy as np
+
+    from gelly_streaming_tpu.ops import triangles as tri
+
+    t0 = time.perf_counter()
+    tri._COMPILE_CAPS[program] = 1 << 30
+    if program == "triangle_stream":
+        k = tri.TriangleWindowKernel(edge_bucket=eb, vertex_bucket=2 * eb)
+        k.MAX_STREAM_WINDOWS = wb
+        k._stream_exec(wb)   # AOT compile only
+    elif program == "fused_scan":
+        from gelly_streaming_tpu.ops.scan_analytics import (
+            StreamSummaryEngine)
+
+        eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=2 * eb)
+        eng.MAX_WINDOWS = wb
+        z = np.zeros(wb * eb, np.int32)
+        eng.process(z, np.ones(wb * eb, np.int32))
+    elif program == "snapshot_scan":
+        from gelly_streaming_tpu.core.driver import (
+            StreamingAnalyticsDriver)
+
+        drv = StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=eb, vertex_bucket=2 * eb,
+            analytics=("degrees", "cc", "bipartite"))
+        drv._SCAN_CHUNK = wb
+        z = np.zeros(wb * eb, np.int32)
+        drv.run_arrays(z, np.ones(wb * eb, np.int32))
+    else:
+        raise SystemExit("unknown probe program %r" % program)
+    print(json.dumps({
+        "program": program, "eb": eb, "wb": wb, "slots": eb * wb,
+        "ok": True, "compile_s": round(time.perf_counter() - t0, 1),
+        "backend": jax.default_backend(),
+    }), flush=True)
+
+
+def _section_compile_probe(key: str, results: dict) -> None:
+    import jax
+
+    from bench import run_json_child
+
+    backend = jax.default_backend()
+    rows = []
+    for program, eb, wb in PROBE_CANDIDATES[key]:
+        got = run_json_child(
+            [sys.executable, os.path.abspath(__file__), "--probe",
+             program, str(eb), str(wb)], PROBE_TIMEOUT_S)
+        row = {"program": program, "eb": eb, "wb": wb,
+               "slots": eb * wb}
+        err = str(got.get("error") or "")
+        if got.get("ok") and got.get("backend") == backend:
+            row.update(ok=True, compile_s=got.get("compile_s"))
+        elif "timeout" in err.lower():
+            # a timed-out compile is the wedge evidence compile_cap
+            # LOWERS on
+            row.update(ok=False, reason=err[:200])
+        else:
+            # crash / backend fell over mid-probe: inconclusive — never
+            # lower a cap over a tunnel flake (ok stays non-boolean,
+            # compile_cap ignores the row)
+            row.update(ok=None,
+                       reason=(err or "backend %s"
+                               % got.get("backend"))[:200])
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    results[key] = rows
+
+
+def section_compile_probe(results: dict) -> None:
+    """Triangle-program cap-raise candidates (one subprocess each)."""
+    _section_compile_probe("compile_probe", results)
+
+
+def section_compile_probe_scan(results: dict) -> None:
+    """Fused/snapshot scan wedge bisection (one subprocess each)."""
+    _section_compile_probe("compile_probe_scan", results)
+
+
+# Order = run order. The wedge-prone whole-pipeline compiles run LAST
+# so a short tunnel window banks the selection-driving sections before
+# risking a per-section timeout: first the probes (each candidate in
+# its own hard-timeout subprocess, committing cap evidence to
+# PERF.json via the per-section flush), THEN fused/driver — whose
+# section children re-read the just-committed caps and so compile at
+# probed-safe sizes instead of wedging >2400s as in r04.
 SECTIONS = {
     "intersect": section_intersect,
     "window": section_window,
@@ -883,6 +995,8 @@ SECTIONS = {
     "trace": section_trace,
     "host_stream": section_host_stream,
     "host_reduce": section_host_reduce,
+    "compile_probe": section_compile_probe,
+    "compile_probe_scan": section_compile_probe_scan,
     "fused": section_fused,
     "driver": section_driver,
 }
@@ -916,6 +1030,10 @@ def run_section_subprocess(name: str, timeout_s: int, env=None) -> dict:
 def main():
     if len(sys.argv) >= 3 and sys.argv[1] == "--section":
         run_section_child(sys.argv[2])
+        return
+    if len(sys.argv) >= 5 and sys.argv[1] == "--probe":
+        run_compile_probe_child(sys.argv[2], int(sys.argv[3]),
+                                int(sys.argv[4]))
         return
 
     args = sys.argv[1:]
