@@ -113,6 +113,43 @@ def _build_snapshot_scan(vb: int, analytics: tuple,
     return run
 
 
+_SNAPSHOT_TIER = None  # resolved once per process (reset below)
+
+
+def _reset_snapshot_tier() -> None:
+    """Test hook: forget the memoized snapshot-tier selection."""
+    global _SNAPSHOT_TIER
+    _SNAPSHOT_TIER = None
+
+
+def resolve_snapshot_tier() -> str:
+    """Batched snapshot-analytics tier: the device scan by default; the
+    native C++ carried union-find (native.snapshot_windows) only when
+    (a) this process runs a CPU backend — on chip the scan always
+    stands — and (b) committed backend-matched `host_snapshot` rows
+    (tools/profile_kernels.py) all show parity and a ≥5% win, and
+    (c) the library exports the symbol. The same measured-default
+    policy as ops/triangles._resolve_stream_impl."""
+    global _SNAPSHOT_TIER
+    if _SNAPSHOT_TIER is not None:
+        return _SNAPSHOT_TIER
+    tier = "scan"
+    try:
+        import jax as _jax
+
+        if _jax.default_backend() == "cpu":
+            perf = tri_ops._load_matching_perf("cpu")
+            if (tri_ops.rows_clear_bar(
+                    (perf or {}).get("host_snapshot", []),
+                    "native_edges_per_s", "scan_edges_per_s")
+                    and native.snapshot_available()):
+                tier = "native"
+    except Exception:
+        pass
+    _SNAPSHOT_TIER = tier
+    return tier
+
+
 @dataclasses.dataclass
 class WindowResult:
     """Per-window analytics snapshot. Vertex-indexed arrays are in dense
@@ -144,12 +181,21 @@ class StreamingAnalyticsDriver:
                  vertex_bucket: int = 1 << 12,
                  edge_bucket: int = 1 << 12,
                  mesh=None, tracing: bool = False,
-                 emit_deltas: bool = False):
+                 emit_deltas: bool = False,
+                 snapshot_tier: str = None):
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
+        if snapshot_tier not in (None, "scan", "native"):
+            raise ValueError(f"unknown snapshot_tier: {snapshot_tier!r}")
+        if snapshot_tier == "native" and not native.snapshot_available():
+            raise ValueError("native snapshot tier pinned but "
+                             "libgsnative lacks gs_snapshot_windows")
         self.window_ms = window_ms
         self.analytics = tuple(analytics)
+        # batched snapshot analytics tier: explicit pin (tests, the
+        # profiler's A/B) or committed-evidence resolution
+        self._snapshot_tier = snapshot_tier
         self.emit_deltas = bool(emit_deltas)
         self.mesh = mesh
         self.timer = StepTimer() if tracing else None
@@ -518,6 +564,30 @@ class StreamingAnalyticsDriver:
         run_scan = any(a in self.analytics
                        for a in ("degrees", "cc", "bipartite"))
         sharded = self._engine is not None
+        # host tier of the snapshot stage (CPU fallback): carried C++
+        # union-find + degree fold producing the SAME per-window `outs`
+        # stacks as the scan. Deltas stay on the scan tier (its
+        # changed-slot masks are computed on device).
+        native_state = None
+        if (run_scan and not sharded and not self.emit_deltas
+                and (self._snapshot_tier or resolve_snapshot_tier())
+                == "native"):
+            deg32 = lab = cov = None
+            if "degrees" in self.analytics:
+                deg32 = np.zeros(self.vb, np.int32)
+                deg32[:len(self._degrees)] = self._degrees
+            if "cc" in self.analytics:
+                lab = np.arange(self.vb, dtype=np.int32)
+                lab[:len(self._cc)] = self._cc
+            if "bipartite" in self.analytics:
+                if len(self._bip) != 2 * self.vb:
+                    self._bip = self._grow_cover(self._bip, self.vb)
+                # COPY (never alias the mirror): the C++ kernel folds
+                # unions in place mid-chunk, and mirrors must only
+                # move at chunk boundaries (the consistency unit —
+                # an exception mid-chunk leaves them resumable)
+                cov = self._bip.astype(np.int32)
+            native_state = (deg32, lab, cov)
         carry = None
         if run_scan and sharded:
             # carried state straight from the engine (its layouts:
@@ -527,7 +597,7 @@ class StreamingAnalyticsDriver:
                     else np.arange(2 * vb + 2, dtype=np.int32))
             carry = (jnp.asarray(st["degree_state"]),
                      jnp.asarray(st["labels"]), jnp.asarray(cov0))
-        elif run_scan:
+        elif run_scan and native_state is None:
             # carried state from the host mirrors (same sources the
             # per-window path uses)
             deg0 = np.zeros(vb + 1, np.int32)
@@ -548,7 +618,18 @@ class StreamingAnalyticsDriver:
         for at in range(0, num_w, scan_chunk):
             chunk = interned[at:at + scan_chunk]
             outs = {}
-            if run_scan:
+            if run_scan and native_state is not None:
+                flat_s = np.concatenate(
+                    [s for _w, s, _d, _n in chunk])
+                flat_d = np.concatenate(
+                    [d for _w, _s, d, _n in chunk])
+                offs = np.zeros(len(chunk) + 1, np.int64)
+                offs[1:] = np.cumsum(
+                    [len(s) for _w, s, _d, _n in chunk])
+                with self._step("snapshot_scan", len(flat_s)):
+                    outs = native.snapshot_windows(
+                        flat_s, flat_d, offs, self.vb, *native_state)
+            elif run_scan:
                 fn, wb = self._scan_fn(len(chunk))
                 s_w = np.full((wb, self.eb), vb, np.int32)
                 d_w = np.full((wb, self.eb), vb, np.int32)

@@ -87,6 +87,19 @@ def _load() -> Optional[ctypes.CDLL]:
         # a stale libgsnative.so missing newer symbols: everything else
         # still works; the affected helpers report unavailable
         pass
+    try:
+        lib.gs_snapshot_windows.restype = ctypes.c_int64
+        lib.gs_snapshot_windows.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+    except AttributeError:
+        pass
     _lib = lib
     return _lib
 
@@ -236,6 +249,66 @@ def windowed_reduce(src: np.ndarray, dst: np.ndarray, val: np.ndarray,
             "%d vertex id(s) outside [0, %d) in windowed_reduce input"
             % (oob, vbp))
     return cells[:num_w], counts[:num_w]
+
+
+def snapshot_available() -> bool:
+    lib = _load()
+    return lib is not None and hasattr(lib, "gs_snapshot_windows")
+
+
+def snapshot_windows(src: np.ndarray, dst: np.ndarray,
+                     offsets: np.ndarray, vb: int,
+                     deg: np.ndarray = None, cc: np.ndarray = None,
+                     cov: np.ndarray = None):
+    """Carried-state windowed snapshot analytics via the C++ kernel
+    (ingest.cpp gs_snapshot_windows) — the host tier of the driver's
+    batched snapshot scan. Window w is the [offsets[w], offsets[w+1])
+    slice of the flat COO arrays (varying lengths — event-time
+    windows). `deg`/`cc`/`cov` are the caller-owned carried arrays
+    (int32 [vb], [vb], [2·vb] — the driver's host mirror layouts, so
+    checkpoints stay tier-interchangeable), updated in place; pass
+    None to skip an analytic. Returns {"deg": [W, vb], "labels":
+    [W, vb], "cover": [W, 2·vb]} int32 snapshot stacks for the
+    enabled analytics (the scan tier's `outs` shape contract), or
+    None when the library/symbol is unavailable."""
+    if not snapshot_available():
+        return None
+    src = np.ascontiguousarray(src, np.int32)
+    dst = np.ascontiguousarray(dst, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int64)
+    num_w = len(offsets) - 1
+    if num_w < 0 or int(offsets[-1]) != len(src):
+        raise ValueError("offsets must span the flat edge arrays")
+    nullp = ctypes.POINTER(ctypes.c_int32)()
+
+    def ptr(a):
+        return _i32ptr(a) if a is not None else nullp
+
+    for name, a, ln in (("deg", deg, vb), ("cc", cc, vb),
+                        ("cov", cov, 2 * vb)):
+        if a is not None and (a.dtype != np.int32 or len(a) != ln
+                              or not a.flags["C_CONTIGUOUS"]):
+            raise ValueError("carried %s must be contiguous int32[%d]"
+                             % (name, ln))
+    flags = ((1 if deg is not None else 0)
+             | (2 if cc is not None else 0)
+             | (4 if cov is not None else 0))
+    od = np.empty((num_w, vb), np.int32) if deg is not None else None
+    oc = np.empty((num_w, vb), np.int32) if cc is not None else None
+    ov = (np.empty((num_w, 2 * vb), np.int32)
+          if cov is not None else None)
+    w = _lib.gs_snapshot_windows(
+        _i32ptr(src), _i32ptr(dst), _i64ptr(offsets), num_w, vb, flags,
+        ptr(deg), ptr(cc), ptr(cov), ptr(od), ptr(oc), ptr(ov))
+    assert w == num_w, (w, num_w)
+    out = {}
+    if od is not None:
+        out["deg"] = od
+    if oc is not None:
+        out["labels"] = oc
+    if ov is not None:
+        out["cover"] = ov
+    return out
 
 
 class NativeInterner:

@@ -357,6 +357,80 @@ int64_t gs_windowed_reduce_i32(const int32_t* src, const int32_t* dst,
                            cells, counts);
 }
 
+// ---------------------------------------------------------------------
+// Carried-state windowed snapshot analytics: degrees + connected-
+// component labels + bipartite double cover over tumbling eb-sized
+// windows, per-window snapshot rows written into caller buffers.
+//
+// Host tier of the driver's batched snapshot scan (core/driver.py
+// _run_batched): the reference computes these in Flink operators
+// (SURVEY.md §2.2-2.3); on a CPU fallback a carried union-find beats
+// re-running the XLA fixpoint scan. Semantics parity with the device
+// path: labels converge to the component's MINIMUM member id (union
+// attaches the larger root beneath the smaller), the double cover
+// joins (u,+)-(w,-) and (u,-)-(w,+) at offset `vb`, and degree state
+// is int32 like the device carry. Carried arrays use the SAME layout
+// as the driver's host mirrors, so checkpoints stay interchangeable
+// between tiers.
+// ---------------------------------------------------------------------
+static inline int32_t snap_find(int32_t* p, int32_t x) {
+    int32_t r = x;
+    while (p[r] != r) r = p[r];
+    while (p[x] != r) {  // path compression
+        int32_t nxt = p[x];
+        p[x] = r;
+        x = nxt;
+    }
+    return r;
+}
+
+static inline void snap_union(int32_t* p, int32_t a, int32_t b) {
+    int32_t ra = snap_find(p, a), rb = snap_find(p, b);
+    if (ra == rb) return;
+    if (ra < rb) p[rb] = ra; else p[ra] = rb;  // min-id root
+}
+
+// flags: bit0 degrees, bit1 cc, bit2 bipartite. Buffers for disabled
+// analytics may be null. Windows are [offsets[w], offsets[w+1])
+// slices of the flat COO arrays (varying lengths — the driver's
+// event-time windows). Snapshot rows: out_deg/out_cc [num_w, vb],
+// out_cov [num_w, 2*vb]. Returns the number of windows written.
+int64_t gs_snapshot_windows(const int32_t* src, const int32_t* dst,
+                            const int64_t* offsets, int64_t num_w,
+                            int64_t vb, int32_t flags,
+                            int32_t* deg, int32_t* cc, int32_t* cov,
+                            int32_t* out_deg, int32_t* out_cc,
+                            int32_t* out_cov) {
+    const bool want_deg = flags & 1, want_cc = flags & 2,
+               want_cov = flags & 4;
+    int64_t w = 0;
+    for (; w < num_w; ++w) {
+        for (int64_t i = offsets[w]; i < offsets[w + 1]; ++i) {
+            const int32_t s = src[i], d = dst[i];
+            if (want_deg) { ++deg[s]; ++deg[d]; }
+            if (want_cc) snap_union(cc, s, d);
+            if (want_cov) {
+                snap_union(cov, s, (int32_t)(d + vb));
+                snap_union(cov, (int32_t)(s + vb), d);
+            }
+        }
+        if (want_deg)
+            std::memcpy(out_deg + w * vb, deg, vb * sizeof(int32_t));
+        if (want_cc) {
+            for (int64_t v = 0; v < vb; ++v)
+                cc[v] = snap_find(cc, (int32_t)v);  // flatten = snapshot
+            std::memcpy(out_cc + w * vb, cc, vb * sizeof(int32_t));
+        }
+        if (want_cov) {
+            for (int64_t v = 0; v < 2 * vb; ++v)
+                cov[v] = snap_find(cov, (int32_t)v);
+            std::memcpy(out_cov + w * 2 * vb, cov,
+                        2 * vb * sizeof(int32_t));
+        }
+    }
+    return w;
+}
+
 // counts[w] = exact triangle count of the w-th tumbling eb-sized
 // window of the stream (the trailing window may be shorter); returns
 // the number of windows written.

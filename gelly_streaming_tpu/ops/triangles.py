@@ -200,6 +200,25 @@ def _load_matching_perf(required_backend: str = None):
         return None
 
 
+def rows_clear_bar(rows, num_key, den, parity_key="parity",
+                   margin=1.05) -> bool:
+    """The shared evidence gate of the measurement-driven selections:
+    True iff `rows` is a non-empty list whose EVERY row has
+    `parity_key` exactly True and `num_key` ≥ margin × denominator
+    (`den`: a row key name, or a callable(row) → float for composite
+    baselines). One place owns the rule so the tier selections can't
+    drift apart on threshold or parity semantics."""
+    if not (isinstance(rows, list) and rows):
+        return False
+    for r in rows:
+        if r.get(parity_key) is not True:
+            return False
+        base = den(r) if callable(den) else (r.get(den) or 0)
+        if (r.get(num_key) or 0) < margin * base:
+            return False
+    return True
+
+
 def _load_tpu_perf():
     """Chip-only view: PERF.json iff both this process and the file are
     'tpu' (drives the Pallas/dense selections, which only exist on
@@ -425,19 +444,14 @@ def _resolve_stream_impl() -> str:
         if _jax.default_backend() == "cpu":
             perf = _load_matching_perf("cpu")
             rows = (perf or {}).get("host_stream", [])
-            if (isinstance(rows, list) and rows
-                    and all(r.get("parity") is True
-                            and (r.get("host_edges_per_s") or 0)
-                            >= 1.05 * (r.get("device_edges_per_s") or 0)
-                            for r in rows)):
+            if rows_clear_bar(rows, "host_edges_per_s",
+                              "device_edges_per_s"):
                 impl = "host"
-            if (isinstance(rows, list) and rows
-                    and all(r.get("native_parity") is True
-                            and (r.get("native_edges_per_s") or 0)
-                            >= 1.05 * max(
-                                r.get("device_edges_per_s") or 0,
-                                r.get("host_edges_per_s") or 0)
-                            for r in rows)):
+            if rows_clear_bar(rows, "native_edges_per_s",
+                              lambda r: max(
+                                  r.get("device_edges_per_s") or 0,
+                                  r.get("host_edges_per_s") or 0),
+                              parity_key="native_parity"):
                 from .. import native as _native
 
                 if _native.triangles_available():
@@ -473,11 +487,8 @@ def resolve_ingress(vb: int) -> str:
         impl = "standard"
         try:
             perf = _load_matching_perf()
-            rows = (perf or {}).get("ingress_ab", [])
-            if (isinstance(rows, list) and rows
-                    and all(r.get("parity") is True
-                            and (r.get("speedup") or 0) >= 1.05
-                            for r in rows)):
+            if rows_clear_bar((perf or {}).get("ingress_ab", []),
+                              "speedup", lambda r: 1.0):
                 impl = "compact"
         except Exception:
             pass

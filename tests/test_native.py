@@ -128,3 +128,143 @@ def test_iter_edge_chunks_prefetch_matches_sync(tmp_path):
 
     with pytest.raises(OSError):
         list(iter_edge_chunks(str(tmp_path / "missing.txt"), prefetch=2))
+
+
+# ----------------------------------------------------------------------
+# native snapshot tier (gs_snapshot_windows): the host form of the
+# driver's batched snapshot scan
+# ----------------------------------------------------------------------
+
+def _tier_drivers(**kw):
+    from gelly_streaming_tpu import native as native_mod
+    from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+
+    if not native_mod.snapshot_available():
+        import pytest
+
+        pytest.skip("libgsnative lacks gs_snapshot_windows")
+    return (StreamingAnalyticsDriver(snapshot_tier="scan", **kw),
+            StreamingAnalyticsDriver(snapshot_tier="native", **kw))
+
+
+def _assert_results_equal(ra, rb):
+    assert len(ra) == len(rb)
+    for x, y in zip(ra, rb):
+        np.testing.assert_array_equal(x.degrees, y.degrees)
+        np.testing.assert_array_equal(x.cc_labels, y.cc_labels)
+        np.testing.assert_array_equal(x.bipartite_odd, y.bipartite_odd)
+        assert x.triangles == y.triangles
+
+
+def test_snapshot_tier_parity_count_windows():
+    """Count-based windows incl. vertex-bucket growth mid-stream and a
+    partial final window: every per-window snapshot identical across
+    tiers."""
+    rng = np.random.default_rng(5)
+    kw = dict(window_ms=0, edge_bucket=256, vertex_bucket=64)
+    a, b = _tier_drivers(**kw)
+    for n, hi in ((1024, 50), (1000, 2000)):  # growth on the 2nd batch
+        src = rng.integers(0, hi, n)
+        dst = rng.integers(0, hi, n)
+        _assert_results_equal(a.run_arrays(src, dst),
+                              b.run_arrays(src, dst))
+
+
+def test_snapshot_tier_parity_event_time():
+    """Event-time windows (varying lengths) through stream_file."""
+    rng = np.random.default_rng(8)
+    n = 4000
+    src = rng.integers(0, 300, n)
+    dst = rng.integers(0, 300, n)
+    ts = np.sort(rng.integers(0, 5000, n))
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write("".join(f"{s} {d} {t}\n"
+                        for s, d, t in zip(src, dst, ts)))
+        path = f.name
+    a, b = _tier_drivers(window_ms=400)
+    _assert_results_equal(list(a.stream_file(path)),
+                          list(b.stream_file(path)))
+
+
+def test_snapshot_tier_checkpoint_interop(tmp_path):
+    """A checkpoint taken under one tier resumes under the OTHER with
+    an identical continuation — the carried layouts are shared."""
+    rng = np.random.default_rng(13)
+    n = 6000
+    src = rng.integers(0, 200, n)
+    dst = rng.integers(0, 200, n)
+    p = tmp_path / "s.txt"
+    p.write_text("".join(f"{s} {d}\n" for s, d in zip(src, dst)))
+    kw = dict(window_ms=0, edge_bucket=512, vertex_bucket=256)
+
+    a_full, b_full = _tier_drivers(**kw)
+    want = a_full.run_file(str(p))
+    _assert_results_equal(want, b_full.run_file(str(p)))
+
+    for first, second in (("native", "scan"), ("scan", "native")):
+        from gelly_streaming_tpu.core.driver import (
+            StreamingAnalyticsDriver)
+
+        ck = str(tmp_path / f"{first}.ckpt")
+        a = StreamingAnalyticsDriver(snapshot_tier=first, **kw)
+        a.enable_auto_checkpoint(ck, every_n_windows=2)
+        seen = 0
+        for _res in a.stream_file(str(p), chunk_bytes=4096):
+            seen += 1
+            if seen == 7:
+                break
+        b = StreamingAnalyticsDriver(snapshot_tier=second, **kw)
+        assert b.try_resume(ck)
+        done = b.windows_done
+        rest = list(b.stream_file(str(p), chunk_bytes=4096,
+                                  resume=True))
+        _assert_results_equal(rest, want[done:])
+
+
+def test_snapshot_tier_resolver_gates(monkeypatch, tmp_path):
+    """resolve_snapshot_tier: evidence-gated like the other selections
+    — flips only on backend-matched all-parity >=5% wins, never on a
+    chip backend."""
+    import json
+
+    import jax
+
+    from gelly_streaming_tpu import native as native_mod
+
+    if not native_mod.snapshot_available():
+        import pytest
+
+        pytest.skip("libgsnative lacks gs_snapshot_windows")
+
+    from gelly_streaming_tpu.core import driver as drv_mod
+    from gelly_streaming_tpu.ops import triangles as tri_mod
+
+    perf = tmp_path / "PERF.json"
+    monkeypatch.setattr(tri_mod, "_PERF_PATH", str(perf))
+
+    def configure(file_backend, process_backend, rows):
+        perf.write_text(json.dumps(
+            {"backend": file_backend, "host_snapshot": rows}))
+        monkeypatch.setattr(jax, "default_backend",
+                            lambda: process_backend)
+        monkeypatch.setattr(drv_mod, "_SNAPSHOT_TIER", None)
+
+    win = [{"parity": True, "scan_edges_per_s": 100,
+            "native_edges_per_s": 900}]
+    configure("cpu", "cpu", win)
+    assert drv_mod.resolve_snapshot_tier() == "native"
+    configure("cpu", "tpu", win)   # chip process: scan always stands
+    assert drv_mod.resolve_snapshot_tier() == "scan"
+    configure("tpu", "cpu", win)   # wrong-backend file
+    assert drv_mod.resolve_snapshot_tier() == "scan"
+    configure("cpu", "cpu", [{"parity": False,
+                              "scan_edges_per_s": 100,
+                              "native_edges_per_s": 900}])
+    assert drv_mod.resolve_snapshot_tier() == "scan"
+    configure("cpu", "cpu", [{"parity": True,
+                              "scan_edges_per_s": 100,
+                              "native_edges_per_s": 103}])
+    assert drv_mod.resolve_snapshot_tier() == "scan"

@@ -870,6 +870,50 @@ def section_ingress_ab(results: dict) -> None:
     results["ingress_ab"] = ab
 
 
+def section_host_snapshot(results: dict) -> None:
+    """Batched snapshot-analytics tiers: the driver's device scan vs
+    the C++ carried union-find (native.snapshot_windows) — the
+    committed evidence core.driver.resolve_snapshot_tier reads.
+    Window-by-window parity asserted before timing; rates are whole
+    run_arrays batches (intern + snapshot + materialize), reset
+    between reps so carried state restarts identically."""
+    import numpy as np
+
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+
+    rows = []
+    for eb in (8_192, 65_536):
+        vb = 4 * eb
+        num_w = 16
+        src, dst = _stream(num_w * eb, vb)
+        kw = dict(window_ms=0, edge_bucket=eb, vertex_bucket=vb,
+                  analytics=("degrees", "cc", "bipartite"))
+        a = StreamingAnalyticsDriver(snapshot_tier="scan", **kw)
+        dev = a.run_arrays(src, dst)
+        row = {"edge_bucket": eb, "windows": num_w}
+        if native.snapshot_available():
+            b = StreamingAnalyticsDriver(snapshot_tier="native", **kw)
+            nat = b.run_arrays(src, dst)
+            row["parity"] = all(
+                np.array_equal(x.degrees, y.degrees)
+                and np.array_equal(x.cc_labels, y.cc_labels)
+                and np.array_equal(x.bipartite_odd, y.bipartite_odd)
+                for x, y in zip(dev, nat))
+
+            def run(drv):
+                drv.reset()
+                drv.run_arrays(src, dst)
+
+            t_dev = _timeit(lambda: run(a), reps=3, warmup=0)
+            t_nat = _timeit(lambda: run(b), reps=3, warmup=0)
+            row["scan_edges_per_s"] = round(num_w * eb / t_dev)
+            row["native_edges_per_s"] = round(num_w * eb / t_nat)
+            row["native_vs_scan"] = round(t_dev / t_nat, 2)
+        rows.append(row)
+    results["host_snapshot"] = rows
+
+
 PROBE_TIMEOUT_S = int(os.environ.get("GS_PROBE_TIMEOUT", "420"))
 
 # Candidate stream programs for the per-program compile caps
@@ -995,6 +1039,7 @@ SECTIONS = {
     "trace": section_trace,
     "host_stream": section_host_stream,
     "host_reduce": section_host_reduce,
+    "host_snapshot": section_host_snapshot,
     "compile_probe": section_compile_probe,
     "compile_probe_scan": section_compile_probe_scan,
     "fused": section_fused,
