@@ -566,10 +566,11 @@ class StreamingAnalyticsDriver:
         sharded = self._engine is not None
         # host tier of the snapshot stage (CPU fallback): carried C++
         # union-find + degree fold producing the SAME per-window `outs`
-        # stacks as the scan. Deltas stay on the scan tier (its
-        # changed-slot masks are computed on device).
+        # stacks as the scan — including, under emit_deltas, the
+        # changed-slot masks (host-diffed against the chunk-start
+        # snapshot below, same semantics as the scan's device masks).
         native_state = None
-        if (run_scan and not sharded and not self.emit_deltas
+        if (run_scan and not sharded
                 and (self._snapshot_tier or resolve_snapshot_tier())
                 == "native"):
             deg32 = lab = cov = None
@@ -626,9 +627,33 @@ class StreamingAnalyticsDriver:
                 offs = np.zeros(len(chunk) + 1, np.int64)
                 offs[1:] = np.cumsum(
                     [len(s) for _w, s, _d, _n in chunk])
+                prevs = (tuple(a.copy() if a is not None else None
+                               for a in native_state)
+                         if self.emit_deltas else None)
                 with self._step("snapshot_scan", len(flat_s)):
                     outs = native.snapshot_windows(
                         flat_s, flat_d, offs, self.vb, *native_state)
+                if prevs is not None:
+                    # changed-slot masks vs the previous window's
+                    # snapshot (row -1 = chunk-start carried state) —
+                    # the scan tier's mask semantics: raw values for
+                    # degrees/labels, the consumer-visible ODD flag
+                    # for the cover
+                    pd, pl, pc = prevs
+                    if "deg" in outs:
+                        outs["deg_chg"] = outs["deg"] != np.concatenate(
+                            [pd[None], outs["deg"][:-1]])
+                    if "labels" in outs:
+                        outs["labels_chg"] = (
+                            outs["labels"] != np.concatenate(
+                                [pl[None], outs["labels"][:-1]]))
+                    if "cover" in outs:
+                        odd = (outs["cover"][:, :self.vb]
+                               == outs["cover"][:, self.vb:])
+                        podd = (pc[:self.vb] == pc[self.vb:])[None]
+                        outs["cover_chg"] = odd != np.concatenate(
+                            [podd, odd[:-1]])
+                        outs["_odd_rows"] = odd  # reused at extraction
             elif run_scan:
                 fn, wb = self._scan_fn(len(chunk))
                 s_w = np.full((wb, self.eb), vb, np.int32)
@@ -666,9 +691,13 @@ class StreamingAnalyticsDriver:
                                 np.int32)
                         res.delta_cc = (idx, res.cc_labels[idx])
                 if "cover" in outs:
-                    plus = outs["cover"][i][:vb]
-                    minus = outs["cover"][i][vb:2 * vb]
-                    res.bipartite_odd = (plus == minus)[:nv]
+                    if "_odd_rows" in outs:  # native delta path: the
+                        # odd matrix was already computed for the mask
+                        res.bipartite_odd = outs["_odd_rows"][i][:nv].copy()
+                    else:
+                        plus = outs["cover"][i][:vb]
+                        minus = outs["cover"][i][vb:2 * vb]
+                        res.bipartite_odd = (plus == minus)[:nv]
                     if "cover_chg" in outs:
                         idx = np.nonzero(
                             outs["cover_chg"][i][:nv])[0].astype(

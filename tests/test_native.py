@@ -268,3 +268,31 @@ def test_snapshot_tier_resolver_gates(monkeypatch, tmp_path):
                               "scan_edges_per_s": 100,
                               "native_edges_per_s": 103}])
     assert drv_mod.resolve_snapshot_tier() == "scan"
+
+
+def test_snapshot_tier_delta_parity():
+    """emit_deltas on the native tier: delta streams identical to the
+    scan tier's device-computed masks, window by window — INCLUDING
+    across chunk boundaries (shrunken _SCAN_CHUNK so the chunk-start
+    `prevs` copy is taken from in-place-mutated carried state) and
+    across mid-stream vertex-bucket growth."""
+    rng = np.random.default_rng(17)
+    kw = dict(window_ms=0, edge_bucket=256, vertex_bucket=512,
+              analytics=("degrees", "cc", "bipartite"),
+              emit_deltas=True)
+    a, b = _tier_drivers(**kw)
+    a._SCAN_CHUNK = b._SCAN_CHUNK = 2  # many chunks per batch
+    for n, hi in ((1024, 500), (700, 500), (1024, 1600)):
+        # 3rd batch grows the vertex bucket mid-stream
+        src = rng.integers(0, hi, n)
+        dst = rng.integers(0, hi, n)
+        ra, rb = a.run_arrays(src, dst), b.run_arrays(src, dst)
+        assert len(ra) == len(rb)
+        for x, y in zip(ra, rb):
+            for field in ("delta_degrees", "delta_cc",
+                          "delta_bipartite"):
+                dx, dy = getattr(x, field), getattr(y, field)
+                assert (dx is None) == (dy is None), field
+                if dx is not None:
+                    np.testing.assert_array_equal(dx[0], dy[0])
+                    np.testing.assert_array_equal(dx[1], dy[1])
