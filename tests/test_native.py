@@ -282,8 +282,10 @@ def test_snapshot_tier_delta_parity():
               emit_deltas=True)
     a, b = _tier_drivers(**kw)
     a._SCAN_CHUNK = b._SCAN_CHUNK = 2  # many chunks per batch
-    for n, hi in ((1024, 500), (700, 500), (1024, 1600)):
-        # 3rd batch grows the vertex bucket mid-stream
+    for n, hi in ((1024, 500), (768, 500), (1025, 1600)):
+        # 3rd batch grows the vertex bucket mid-stream and ends on a
+        # partial window (only the FINAL batch may: count-based
+        # tumbling semantics)
         src = rng.integers(0, hi, n)
         dst = rng.integers(0, hi, n)
         ra, rb = a.run_arrays(src, dst), b.run_arrays(src, dst)
