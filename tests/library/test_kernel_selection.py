@@ -122,12 +122,16 @@ def test_compile_cap_raised_by_clean_probe_row(selection_env):
     assert triangles._default_chunk(32768) == 32
 
 
+FUSED_WEDGE_ROWS = [
+    {"program": "fused_scan", "slots": 1 << 19, "ok": False,
+     "reason": "timeout"},
+    {"program": "fused_scan", "slots": 1 << 17, "ok": True,
+     "compile_s": 30.0},
+]
+
+
 def test_compile_cap_lowered_by_probed_failure(selection_env):
-    selection_env("tpu", "tpu", compile_probe_scan=[
-        {"program": "fused_scan", "slots": 1 << 19, "ok": False,
-         "reason": "timeout"},
-        {"program": "fused_scan", "slots": 1 << 17, "ok": True,
-         "compile_s": 30.0}])
+    selection_env("tpu", "tpu", compile_probe_scan=FUSED_WEDGE_ROWS)
     assert triangles.compile_cap("fused_scan") == 1 << 17
     # no clean row below the failure: quarter of the failing size
     triangles._reset_compile_caps()
@@ -166,6 +170,18 @@ def test_compile_cap_ignores_other_backend_and_programs(selection_env):
         {"program": "triangle_stream", "slots": 1 << 20, "ok": True}])
     # another program's rows never move this program's cap
     assert triangles.compile_cap("fused_scan") == 1 << 19
+
+
+def test_fused_engine_honors_lowered_cap(selection_env):
+    # a probed fused-scan wedge at 2^19 with a clean 2^17 row must
+    # shrink the engine's windows-per-dispatch on a chip backend
+    # (2^17 / eb=8192 -> 16), while the triangle kernel keeps ITS cap
+    selection_env("tpu", "tpu", compile_probe_scan=FUSED_WEDGE_ROWS)
+    from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+
+    eng = StreamSummaryEngine(edge_bucket=8192, vertex_bucket=16384)
+    assert eng.MAX_WINDOWS == 16
+    assert triangles._default_chunk(8192) == 64  # 2^19 / 8192
 
 
 def test_capped_chunk_unlimited_off_chip(selection_env):

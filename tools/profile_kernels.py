@@ -1023,17 +1023,20 @@ def section_compile_probe_scan(results: dict) -> None:
     _section_compile_probe("compile_probe_scan", results)
 
 
-# Order = run order. The wedge-prone whole-pipeline compiles run LAST
-# so a short tunnel window banks the selection-driving sections before
-# risking a per-section timeout: first the probes (each candidate in
-# its own hard-timeout subprocess, committing cap evidence to
-# PERF.json via the per-section flush), THEN fused/driver — whose
-# section children re-read the just-committed caps and so compile at
-# probed-safe sizes instead of wedging >2400s as in r04.
+# Order = run order. EVERY wedge-prone compile runs LAST — including
+# the cap-raise probes: killing a probing subprocess at its timeout
+# does NOT un-wedge the tunnel's remote compile SERVICE (round 2: one
+# oversized program stalled it for hours), so a probe placed early
+# could cost every later section its 2400s against a dead compiler. A
+# clean probe's raised cap therefore benefits the NEXT window's chunk
+# sweep (the sweep anchors on _default_chunk, which reads committed
+# caps); fused/driver still run after the probes in the SAME window,
+# re-reading the just-flushed caps so they compile at probed-safe
+# sizes instead of wedging >2400s as in r04.
 SECTIONS = {
     "intersect": section_intersect,
-    "window": section_window,
     "ingress_ab": section_ingress_ab,
+    "window": section_window,
     "dense": section_dense,
     "roofline": section_roofline,
     "trace": section_trace,
