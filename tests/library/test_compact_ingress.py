@@ -153,3 +153,26 @@ def test_compact_stream_id_65535():
     c, o = run(jnp.asarray(s16), jnp.asarray(d16), jnp.asarray(nvalid))
     assert int(np.array(o)[0]) == 0
     assert int(np.array(c)[0]) == 1
+
+
+def test_compact_overflow_recount_exact():
+    """A hub whose oriented degree overflows the pinned K must be
+    recounted exactly through the compact dispatch path (the shared
+    _run_stack_loop recount branch)."""
+    vb, eb = 256, 128
+    # star around vertex 0 + closing edges -> many triangles at the hub
+    hub_deg = 60
+    src = np.concatenate([np.zeros(hub_deg, np.int64),
+                          np.arange(1, hub_deg, dtype=np.int64)])
+    dst = np.concatenate([np.arange(1, hub_deg + 1, dtype=np.int64),
+                          np.arange(2, hub_deg + 1, dtype=np.int64)])
+    src = src.astype(np.int32)[:eb]
+    dst = dst.astype(np.int32)[:eb]
+    k_std = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                 k_bucket=4, ingress="standard")
+    k_cmp = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                 k_bucket=4, ingress="compact")
+    want = [k_std.count(src, dst)]  # escalating exact path
+    assert k_std._count_stream_device(src, dst) == want
+    assert k_cmp._count_stream_device(src, dst) == want
+    assert want[0] > 0
