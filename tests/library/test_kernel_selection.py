@@ -377,3 +377,23 @@ def test_stream_impl_survives_other_backend_profile(
     (tmp_path / "PERF_cpu.json").write_text(_json.dumps(
         {"backend": "cpu", "host_stream": HOST_WIN}))
     assert triangles._resolve_stream_impl() == "host"
+
+
+def test_winning_ingress_rows_flip_a_fresh_kernel(selection_env):
+    """Integration: committed winning ingress_ab rows make a FRESH
+    unpinned kernel dispatch compact, with counts identical to the
+    standard form (the adoption path bench would take on chip)."""
+    import numpy as np
+
+    selection_env("cpu", "cpu", ingress_ab=INGRESS_WIN)
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    auto = TriangleWindowKernel(edge_bucket=128, vertex_bucket=256)
+    assert auto.ingress == "compact"
+    rng = np.random.default_rng(2)
+    src = rng.integers(0, 256, 500).astype(np.int32)
+    dst = rng.integers(0, 256, 500).astype(np.int32)
+    std = TriangleWindowKernel(edge_bucket=128, vertex_bucket=256,
+                               ingress="standard")
+    assert (auto._count_stream_device(src, dst)
+            == std._count_stream_device(src, dst))
