@@ -8,8 +8,9 @@ that emits an improved global state after every incoming partial.
 TPU shape: the per-partition window fold is the parallel part — with a
 device `fold_kernel` it runs as one XLA program per window batch
 (e.g. array union-find, ops/unionfind.py), and in multi-chip mode the
-partials are merged with collectives (parallel/merge_tree.py) instead
-of the host Merger.
+partials are merged with collectives (the psum/pmin merges fused into
+the window programs in parallel/sharded.py) instead of the host
+Merger.
 """
 
 from __future__ import annotations

@@ -213,8 +213,13 @@ def rows_clear_bar(rows, num_key, den, parity_key="parity",
     for r in rows:
         if r.get(parity_key) is not True:
             return False
-        base = den(r) if callable(den) else (r.get(den) or 0)
-        if (r.get(num_key) or 0) < margin * base:
+        num = r.get(num_key)
+        base = den(r) if callable(den) else r.get(den)
+        # a malformed row (missing/zero rate on either side) must fail
+        # the gate, not pass it vacuously (0 >= margin*0)
+        if not num or not base or num <= 0 or base <= 0:
+            return False
+        if num < margin * base:
             return False
     return True
 
@@ -539,15 +544,21 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
     break the kernel's range stepping (ADVICE r3)."""
     perf = _load_matching_perf()
     if perf is not None:
-        for row in perf.get("window", []):
-            if row.get("edge_bucket") != eb:
-                continue
-            measured = [s for s in row.get(sweep_key, [])
-                        if s.get("per_window_ms") and s.get(value_key)]
-            if measured:
-                default = max(1, int(min(
-                    measured,
-                    key=lambda s: s["per_window_ms"])[value_key]))
+        # chunk_deep rows (tools/profile_kernels.section_chunk_deep)
+        # extend the window section's sweep past the pre-probe compile
+        # cap in the same chip window; they carry the same
+        # {edge_bucket, chunk_sweep: [...]} shape and no k_sweep, so
+        # merging them here is a no-op for the K selection.
+        rows = (list(perf.get("window", []) or [])
+                + list(perf.get("chunk_deep", []) or []))
+        measured = [s for row in rows
+                    if row.get("edge_bucket") == eb
+                    for s in row.get(sweep_key, []) or []
+                    if s.get("per_window_ms") and s.get(value_key)]
+        if measured:
+            default = max(1, int(min(
+                measured,
+                key=lambda s: s["per_window_ms"])[value_key]))
     return default
 
 _TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch
@@ -600,7 +611,12 @@ def compile_cap(program: str = "triangle_stream") -> int:
                         if r.get("ok") is False and r.get("slots"))
         if clean:
             cap = max(cap, clean[-1])
-        if failed and failed[0] <= cap:
+        if failed and failed[0] <= cap and not (
+                clean and clean[-1] >= failed[0]):
+            # Lower only when no clean row exists at/above the failing
+            # size: a successful compile is direct evidence of the
+            # shape, while a probe timeout can be a tunnel flake — on
+            # contradictory rows the measured success wins (ADVICE r4).
             floor = [s for s in clean if s < failed[0]]
             proven = _PROVEN_CLEAN.get(program)
             if proven is not None and proven < failed[0]:
@@ -647,9 +663,24 @@ def _tuned_chunk(eb: int) -> int:
     chunk size sets how that latency amortizes."""
     if eb in _TUNED_CHUNK:
         return _TUNED_CHUNK[eb]
-    _TUNED_CHUNK[eb] = _fastest_sweep_row(
+    val = _fastest_sweep_row(
         eb, "chunk_sweep", "windows_per_dispatch",
         default=_default_chunk(eb))
+    # On chip, a measured depth never overrides the CURRENT compile
+    # cap: a chunk_deep row persisted under a since-lowered cap would
+    # otherwise re-compile the exact oversized program the cap exists
+    # to prevent (the >25-min remote-compiler wedge). Off-chip the
+    # host compiler has no wedge and sweeps legitimately measure past
+    # the class default, so no clamp there.
+    try:
+        import jax as _jax
+
+        if _jax.default_backend() == "tpu":
+            val = min(val, max(1, compile_cap("triangle_stream")
+                               // max(eb, 1)))
+    except Exception:
+        pass
+    _TUNED_CHUNK[eb] = val
     return _TUNED_CHUNK[eb]
 
 

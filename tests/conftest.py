@@ -18,6 +18,12 @@ import pytest  # noqa: E402
 from gelly_streaming_tpu import Edge, ManualClock, StreamEnvironment  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running leg (kept in-suite; the mark "
+        "documents the cost and allows -m 'not slow' deselection)")
+
+
 @pytest.fixture
 def env():
     """Deterministic environment: manual ingestion clock pinned at 0 so a

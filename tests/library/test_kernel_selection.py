@@ -267,6 +267,79 @@ def test_tuned_chunk_reads_matching_backend_sweep(selection_env):
             == triangles.TriangleWindowKernel.MAX_STREAM_WINDOWS)
 
 
+def test_tuned_chunk_merges_chunk_deep_rows(selection_env):
+    """chunk_deep rows (the in-window post-probe deep sweep,
+    tools/profile_kernels.section_chunk_deep) extend the window
+    section's sweep: the fastest row across BOTH sections wins."""
+    cap_raise = [{"program": "triangle_stream", "slots": 1 << 20,
+                  "ok": True, "compile_s": 40.0}]
+    selection_env("tpu", "tpu", window=[{
+        "edge_bucket": 32768,
+        "chunk_sweep": [
+            {"windows_per_dispatch": 8, "per_window_ms": 9.0},
+            {"windows_per_dispatch": 16, "per_window_ms": 7.5},
+        ]}], chunk_deep=[{
+            "edge_bucket": 32768,
+            "chunk_sweep": [
+                {"windows_per_dispatch": 32, "per_window_ms": 6.1},
+            ]}], compile_probe=cap_raise)
+    assert triangles._tuned_chunk(32768) == 32
+    # a SLOWER deep row must not displace the window section's winner
+    triangles._TUNED_CHUNK.clear()
+    selection_env("tpu", "tpu", window=[{
+        "edge_bucket": 32768,
+        "chunk_sweep": [
+            {"windows_per_dispatch": 16, "per_window_ms": 7.5}]}],
+        chunk_deep=[{
+            "edge_bucket": 32768,
+            "chunk_sweep": [
+                {"windows_per_dispatch": 32, "per_window_ms": 8.8}]}])
+    assert triangles._tuned_chunk(32768) == 16
+
+
+def test_tuned_chunk_clamped_to_current_cap_on_chip(selection_env):
+    """A persisted deep-sweep depth measured under a since-lowered cap
+    must not drive a dispatch above the CURRENT cap (it would
+    recompile the exact oversized program the cap exists to prevent)."""
+    selection_env("tpu", "tpu", chunk_deep=[{
+        "edge_bucket": 32768,
+        "chunk_sweep": [{"windows_per_dispatch": 32,
+                         "per_window_ms": 6.0}]}],
+        compile_probe=[{"program": "triangle_stream", "slots": 1 << 18,
+                        "ok": False, "reason": "timeout"}])
+    # cap fell to 2^16 (failure/4, no clean rows): 2^16/32768 = 2
+    assert triangles.compile_cap("triangle_stream") == 1 << 16
+    assert triangles._tuned_chunk(32768) == 2
+
+
+def test_compile_cap_contradiction_trusts_clean_row_above_failure(
+        selection_env):
+    """A clean probe row LARGER than a failure is contradictory
+    evidence; the measured success wins (a compile that finished is
+    direct proof of the shape, a timeout can be a tunnel flake) —
+    ADVICE r4: the cap must not drop below a proven-clean size."""
+    selection_env("tpu", "tpu", compile_probe=[
+        {"program": "triangle_stream", "slots": 1 << 20, "ok": True,
+         "compile_s": 44.0},
+        {"program": "triangle_stream", "slots": 1 << 19, "ok": False,
+         "reason": "timeout"}])
+    assert triangles.compile_cap("triangle_stream") == 1 << 20
+
+
+def test_rows_clear_bar_rejects_malformed_rows():
+    """parity True with a missing/zero rate on either side must FAIL
+    the gate, not pass vacuously (ADVICE r4: 0 >= margin*0)."""
+    bar = triangles.rows_clear_bar
+    assert bar([{"parity": True, "a": 110, "b": 100}], "a", "b")
+    assert not bar([{"parity": True}], "a", "b")            # no rates
+    assert not bar([{"parity": True, "a": 110}], "a", "b")  # no denom
+    assert not bar([{"parity": True, "b": 100}], "a", "b")  # no numer
+    assert not bar([{"parity": True, "a": 0, "b": 0}], "a", "b")
+    # callable denominators get the same guard
+    assert not bar([{"parity": True, "a": 110}], "a", lambda r: 0.0)
+    assert bar([{"parity": True, "a": 110}], "a", lambda r: 100.0)
+
+
 def test_tuned_chunk_backend_mismatch_keeps_default(selection_env):
     selection_env("tpu", "cpu", window=[{
         "edge_bucket": 8192,

@@ -123,8 +123,8 @@ def run(fixture: str, out_path: str) -> None:
     assert resumed_at <= crash_at, (resumed_at, crash_at)
     # lag bound: one checkpoint interval plus one scan chunk (staging
     # happens at scan-chunk boundaries; driver._stage_ckpt)
-    assert resumed_at >= crash_at - CKPT_EVERY - 64, (resumed_at,
-                                                     crash_at)
+    assert resumed_at >= crash_at - CKPT_EVERY - drv._SCAN_CHUNK, (
+        resumed_at, crash_at)
     drv.enable_auto_checkpoint(ckpt, every_n_windows=CKPT_EVERY)
     rss_samples, finish = leg("endurance_phase_b_resume")
     windows = edges = 0

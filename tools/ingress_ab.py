@@ -125,7 +125,11 @@ def stream_ab(jax, jnp, num_edges, results):
 
     t_std = _median_time(run_std, reps=3, warmup=1)
     t_cmp = _median_time(run_cmp, reps=3, warmup=1)
-    assert counts_std == counts_cmp, "parity failure between ingress forms"
+    # A parity failure is committed as evidence ({parity: false}, no
+    # speedup claim) instead of crashing the tool and losing the whole
+    # section's probe rows; the selection gate (rows_clear_bar)
+    # rejects the row, so compact ingress is never adopted on it.
+    parity = counts_std == counts_cmp
     row = {
         "probe": "stream_ab",
         "backend": jax.default_backend(),
@@ -136,9 +140,12 @@ def stream_ab(jax, jnp, num_edges, results):
         "std_edges_per_s": round(len(src) / t_std),
         "compact_s": round(t_cmp, 3),
         "compact_edges_per_s": round(len(src) / t_cmp),
-        "speedup": round(t_std / t_cmp, 3),
-        "parity": True,
+        "parity": bool(parity),
     }
+    if parity:
+        row["speedup"] = round(t_std / t_cmp, 3)
+    else:
+        print("PARITY FAILURE between ingress forms", file=sys.stderr)
     results.append(row)
     print(json.dumps(row), flush=True)
 

@@ -1013,6 +1013,57 @@ def _section_compile_probe(key: str, results: dict) -> None:
     results[key] = rows
 
 
+def section_chunk_deep(results: dict) -> None:
+    """Chunk sweep ABOVE the pre-probe compile cap. Runs after the
+    compile_probe section in the same window: this child re-reads the
+    just-flushed PERF.json, so a clean probe row at 2^20 raises
+    capped_chunk here and the sweep measures windows-per-dispatch
+    depths the window section's anchor-bounded sweep could not reach
+    (r04: the chip sweep was still climbing — 962K edges/s at 16 —
+    when it hit the 2^19 cap). Rows land under `chunk_deep` and merge
+    into the runtime's chunk selection via
+    ops/triangles._fastest_sweep_row, so the queue's next bench
+    dispatches at the fastest measured depth."""
+    from gelly_streaming_tpu.ops import triangles as tri
+
+    out = []
+    for eb in (32_768, 8_192):
+        vb = 2 * eb
+        cap_c = tri.capped_chunk(eb, "triangle_stream")
+        perf = tri._load_matching_perf() or {}
+        measured = [
+            int(s["windows_per_dispatch"])
+            for key in ("window", "chunk_deep")
+            for row in perf.get(key, []) or []
+            if row.get("edge_bucket") == eb
+            for s in row.get("chunk_sweep", []) or []
+            if s.get("windows_per_dispatch")]
+        hi = max(measured, default=0)
+        cands = sorted({c for c in (cap_c, cap_c // 2) if c > hi})
+        row = {"edge_bucket": eb, "cap_chunk": cap_c,
+               "measured_max": hi, "chunk_sweep": []}
+        if not cands:
+            row["note"] = "no candidates above measured depth"
+            out.append(row)
+            continue
+        kern = tri.TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+        num_w = max(cands)
+        src, dst = _stream(num_w * eb, vb, seed=8)
+        row.update(k_bucket=kern.kb, windows=num_w)
+        for cs in cands:
+            kern.MAX_STREAM_WINDOWS = cs
+            kern._count_stream_device(src, dst)  # compile + warm
+            t = _timeit(lambda: kern._count_stream_device(src, dst),
+                        reps=3, warmup=0)
+            row["chunk_sweep"].append({
+                "windows_per_dispatch": cs,
+                "per_window_ms": round(t / num_w * 1e3, 3),
+                "edges_per_s": round(num_w * eb / t),
+            })
+        out.append(row)
+    results["chunk_deep"] = out
+
+
 def section_compile_probe(results: dict) -> None:
     """Triangle-program cap-raise candidates (one subprocess each)."""
     _section_compile_probe("compile_probe", results)
@@ -1037,14 +1088,15 @@ SECTIONS = {
     "intersect": section_intersect,
     "ingress_ab": section_ingress_ab,
     "window": section_window,
-    "dense": section_dense,
-    "roofline": section_roofline,
-    "trace": section_trace,
     "host_stream": section_host_stream,
     "host_reduce": section_host_reduce,
     "host_snapshot": section_host_snapshot,
     "compile_probe": section_compile_probe,
     "compile_probe_scan": section_compile_probe_scan,
+    "chunk_deep": section_chunk_deep,
+    "dense": section_dense,
+    "roofline": section_roofline,
+    "trace": section_trace,
     "fused": section_fused,
     "driver": section_driver,
 }

@@ -1,54 +1,122 @@
 #!/bin/bash
-# TPU work queue: poll the tunnel; when it answers, run the round's
-# evidence suite sequentially (bench -> kernel profile -> scale run).
-# Each stage tees raw stdout/stderr to logs/ (committed — chip evidence
-# must never exist only as a transcription); the queue stops polling
-# after MAX_WAIT_S without a live backend.
+# TPU work queue (round 5): poll the tunnel; when it answers, run the
+# round's evidence suite in RISK order — insurance bench first, then
+# wedge-SAFE profiler sections (ingress A/B, k+chunk sweeps, host
+# tiers), then a tuned bench, then the compile-cap probes and
+# wedge-prone sections (dense/fused/driver LAST: round 4 lost the
+# window's tail to one 2400s wedged compile), then a final bench that
+# reads any raised caps, then the scale ladder. The profiler's
+# `sharded` section (CPU-mesh collectives; no chip needed) runs at the
+# very end so it never competes with chip stages for the host core.
+#
+# Each stage tees raw stdout/stderr to logs/ AND git-commits the
+# evidence immediately — chip numbers must never exist only in a
+# process that a dropped tunnel or ended session can take with it.
 set -u
-MAX_WAIT_S=${MAX_WAIT_S:-14400}
-POLL_S=${POLL_S:-180}
-RTAG=${RTAG:-r04}
+MAX_WAIT_S=${MAX_WAIT_S:-39600}
+POLL_S=${POLL_S:-120}
+RTAG=${RTAG:-r05}
 cd /root/repo
 mkdir -p logs
+
+log() { echo "$(date -u +%H:%M:%S) $*"; }
+
+commit_evidence() {
+  # One `git add` per path, existing paths only: a single atomic add
+  # with one missing pathspec (e.g. PERF.json.partial before any
+  # profiler run) stages NOTHING and silently skips the checkpoint.
+  local p
+  for p in logs PERF.json PERF_tpu.json PERF_cpu.json \
+           PERF.json.partial PERF.md; do
+    [ -e "$p" ] && git add "$p" >/dev/null 2>&1
+  done
+  # Best-effort: index-lock contention just skips this checkpoint; the
+  # next stage commits the same paths.
+  git commit -q -m "$1" >/dev/null 2>&1 && log "committed: $1" || true
+}
+
+# fresh_chip_rows STAMP: PERF.json was (re)written after STAMP by a
+# profiler run that landed at least one chip-labeled section (flush()
+# writes the non-.partial file only then). Guards against gating on
+# the committed previous-round PERF.json, which is already tpu-labeled.
+fresh_chip_rows() {
+  [ PERF.json -nt "$1" ] && grep -q '"backend": "tpu"' PERF.json
+}
 
 waited=0
 while true; do
   if timeout 90 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
-    echo "$(date -u +%H:%M:%S) tunnel is up" ; break
+    log "tunnel is up"; break
   fi
   waited=$((waited + POLL_S))
   if [ "$waited" -ge "$MAX_WAIT_S" ]; then
-    echo "$(date -u +%H:%M:%S) gave up waiting for tunnel"; exit 2
+    log "gave up waiting for tunnel"; exit 2
   fi
-  echo "$(date -u +%H:%M:%S) tunnel down; waited ${waited}s"
+  log "tunnel down; waited ${waited}s"
   sleep "$POLL_S"
 done
 
-echo "=== stage 1: bench.py (first number in hand, untuned K) ==="
-timeout 5400 python bench.py >"logs/bench_${RTAG}_stage1.log" 2>"logs/bench_${RTAG}_stage1.err"
-echo "bench rc=$? ; $(tail -1 "logs/bench_${RTAG}_stage1.log" 2>/dev/null)"
+log "=== stage 1: bench.py (insurance number, committed selections) ==="
+timeout 4500 python bench.py \
+  >"logs/bench_${RTAG}_stage1.log" 2>"logs/bench_${RTAG}_stage1.err"
+log "bench rc=$?; $(tail -1 "logs/bench_${RTAG}_stage1.log" 2>/dev/null)"
+commit_evidence "${RTAG} chip: stage1 bench"
 
-echo "=== stage 2: profile_kernels (chip k-sweep + roofline + trace + sharded collectives) ==="
-timeout 7200 python tools/profile_kernels.py >"logs/profile_${RTAG}.log" 2>"logs/profile_${RTAG}.err"
-prof_rc=$?
-echo "profile rc=$prof_rc"
-# regenerate the human-readable evidence tables from PERF.json in the
-# same unattended window (no transcription step to lose)
-timeout 120 python tools/update_perf_md.py >>"logs/profile_${RTAG}.log" 2>&1
-echo "perf_md rc=$?"
+log "=== stage 2: wedge-safe profiler sections ==="
+touch .queue_stage2_stamp
+timeout 4800 python tools/profile_kernels.py \
+  intersect ingress_ab window host_stream host_reduce host_snapshot \
+  >"logs/profile_${RTAG}_safe.log" 2>"logs/profile_${RTAG}_safe.err"
+log "profile-safe rc=$?"
+timeout 120 python tools/update_perf_md.py \
+  >>"logs/profile_${RTAG}_safe.log" 2>&1
+commit_evidence "${RTAG} chip: safe profiler sections (ingress A/B, sweeps, host tiers)"
 
-# gate on what stage 3 actually consumes: a chip-labeled k-sweep in
-# the COMMITTED PERF.json (a CPU-fallback profile writes .partial only
-# and still exits 0)
-if [ "$prof_rc" -eq 0 ] && grep -q '"backend": "tpu"' PERF.json 2>/dev/null; then
-  echo "=== stage 3: bench.py again (now reads the chip-tuned K from PERF.json) ==="
-  timeout 5400 python bench.py >"logs/bench_${RTAG}_stage3.log" 2>"logs/bench_${RTAG}_stage3.err"
-  echo "bench2 rc=$? ; $(tail -1 "logs/bench_${RTAG}_stage3.log" 2>/dev/null)"
+if fresh_chip_rows .queue_stage2_stamp; then
+  log "=== stage 3: bench.py (chip-tuned K / ingress / chunk) ==="
+  timeout 4500 python bench.py \
+    >"logs/bench_${RTAG}_stage3.log" 2>"logs/bench_${RTAG}_stage3.err"
+  log "bench2 rc=$?; $(tail -1 "logs/bench_${RTAG}_stage3.log" 2>/dev/null)"
+  commit_evidence "${RTAG} chip: stage3 tuned bench"
 else
-  echo "stage 3 skipped: no chip-labeled k-sweep to consume (profile rc=$prof_rc)"
+  log "stage 3 skipped: stage 2 landed no fresh chip rows"
 fi
 
-echo "=== stage 4: scale_run (driver+fused on chip, sharded on cpu mesh) ==="
-timeout 7200 python tools/scale_run.py >"logs/scale_${RTAG}.log" 2>"logs/scale_${RTAG}.err"
-echo "scale rc=$?"
-echo "queue done"
+log "=== stage 4: compile probes + wedge-prone sections (LAST) ==="
+touch .queue_stage4_stamp
+timeout 9000 python tools/profile_kernels.py \
+  compile_probe compile_probe_scan chunk_deep dense roofline trace \
+  fused driver \
+  >"logs/profile_${RTAG}_deep.log" 2>"logs/profile_${RTAG}_deep.err"
+log "profile-deep rc=$?"
+timeout 120 python tools/update_perf_md.py \
+  >>"logs/profile_${RTAG}_deep.log" 2>&1
+commit_evidence "${RTAG} chip: probes + deep sections (caps, MFU, chunk_deep)"
+
+# Gate on THIS stage's log, not PERF.json: the merged file retains a
+# prior run's chunk_deep rows even when this stage's section failed
+# (flush() records chunk_deep_error alongside). The orchestrator
+# prints {"chunk_deep": [...]} only on a fresh success.
+if fresh_chip_rows .queue_stage4_stamp \
+    && grep -q '"chunk_deep": \[' "logs/profile_${RTAG}_deep.log"; then
+  log "=== stage 5: bench.py (re-reads raised caps / deep chunks) ==="
+  timeout 4500 python bench.py \
+    >"logs/bench_${RTAG}_stage5.log" 2>"logs/bench_${RTAG}_stage5.err"
+  log "bench3 rc=$?; $(tail -1 "logs/bench_${RTAG}_stage5.log" 2>/dev/null)"
+  commit_evidence "${RTAG} chip: stage5 deep-chunk bench"
+else
+  log "stage 5 skipped: no fresh chunk_deep rows landed"
+fi
+
+log "=== stage 6: scale_run (chip legs) ==="
+timeout 7200 python tools/scale_run.py \
+  >"logs/scale_${RTAG}.log" 2>"logs/scale_${RTAG}.err"
+log "scale rc=$?"
+commit_evidence "${RTAG} chip: scale ladder"
+
+log "=== stage 7: sharded collectives section (CPU mesh; chip-free) ==="
+timeout 3600 python tools/profile_kernels.py sharded \
+  >"logs/profile_${RTAG}_sharded.log" 2>"logs/profile_${RTAG}_sharded.err"
+log "sharded rc=$?"
+commit_evidence "${RTAG}: sharded collectives refresh (CPU mesh)"
+log "queue done"
