@@ -88,6 +88,25 @@ def _load() -> Optional[ctypes.CDLL]:
         # still works; the affected helpers report unavailable
         pass
     try:
+        lib.gs_windowed_reduce_i32o.restype = ctypes.c_int64
+        lib.gs_windowed_reduce_i32o.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+        lib.gs_windowed_reduce_i64i32o.restype = ctypes.c_int64
+        lib.gs_windowed_reduce_i64i32o.argtypes = [
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_int32, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+        ]
+    except AttributeError:
+        pass
+    try:
         lib.gs_snapshot_windows.restype = ctypes.c_int64
         lib.gs_snapshot_windows.argtypes = [
             ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
@@ -211,27 +230,69 @@ def windowed_reduce(src: np.ndarray, dst: np.ndarray, val: np.ndarray,
                     eb: int, vbp: int, name: str, direction: str,
                     ident: int):
     """Fused (cells, counts) windowed reduce via the C++ kernel
-    (ingest.cpp gs_windowed_reduce) — the native tier of
+    (ingest.cpp gs_windowed_reduce*) — the native tier of
     ops/windowed_reduce.WindowedEdgeReduce for integer values. Returns
-    (cells [num_w, vbp] int64, counts [num_w, vbp] int64), cells
-    pre-filled with `ident`; None when the library/symbol is
-    unavailable (callers fall back to the numpy tier)."""
+    (cells [num_w, vbp], counts [num_w, vbp]); cells pre-filled with
+    `ident`. The slab dtype is int32 when the fast forms apply (int32
+    values whose worst-case cell sum provably fits int32 — evaluated
+    PER CALL, so a chunked stream can legitimately return int32 rows
+    for one chunk and int64 for another; every consumer of the
+    (cells, counts) contract is dtype-agnostic, and the engine casts
+    cells back to the value dtype) and int64 otherwise. None when the
+    library/symbol is unavailable (callers fall back to the numpy
+    tier)."""
     if not windowed_reduce_available():
         return None
     n = len(src)
     num_w = -(-n // eb) if n else 0
+    src, dst, val = (np.asarray(a) for a in (src, dst, val))
+    ids_i32 = src.dtype == np.int32 and dst.dtype == np.int32
+    ids_i64 = src.dtype == np.int64 and dst.dtype == np.int64
+    # int32-output fast forms (gs_windowed_reduce_i32o /
+    # _i64i32o): int32 output slabs halve the faulted/written output
+    # bytes and make the engine's astype-back a no-op. Gated on the
+    # same worst-case-sum bound as the numpy tier's exact_bincount
+    # guard: max|val| × the most contributions one cell can receive
+    # must fit int32 (min/max outputs are input values — always
+    # safe). ident fits int32 by construction for int32 values. Ids
+    # stay their own width — the i64-id form keeps the unsigned bound
+    # check exact for ids beyond int32 (reported, never wrapped).
+    out_i32 = (val.dtype == np.int32 and (ids_i32 or ids_i64)
+               and getattr(_lib, "gs_windowed_reduce_i64i32o", None)
+               is not None)
+    if out_i32 and name == "sum" and n:
+        per_cell = eb * (2 if direction == "all" else 1)
+        # exact max|val| via two scans in Python ints (np.abs wraps on
+        # INT32_MIN and would pass the gate with a negative bound)
+        maxabs = max(int(val.max()), -int(val.min()))
+        out_i32 = maxabs * per_cell <= np.iinfo(np.int32).max
+    out_dt = np.int32 if out_i32 else np.int64
     if ident == 0:
         # calloc-backed zeros: the kernel touches only real cells, so
         # the identity fill is free (np.full writes the whole slab)
-        cells = np.zeros((max(num_w, 1), vbp), np.int64)
+        cells = np.zeros((max(num_w, 1), vbp), out_dt)
     else:
-        cells = np.full((max(num_w, 1), vbp), ident, np.int64)
-    counts = np.zeros((max(num_w, 1), vbp), np.int64)
-    arrs = [np.asarray(a) for a in (src, dst, val)]
-    if all(a.dtype == np.int32 for a in arrs):
-        src32, dst32, val32 = (np.ascontiguousarray(a) for a in arrs)
+        cells = np.full((max(num_w, 1), vbp), ident, out_dt)
+    counts = np.zeros((max(num_w, 1), vbp), out_dt)
+    if out_i32 and ids_i32:
+        s32, d32, v32 = (np.ascontiguousarray(a)
+                         for a in (src, dst, val))
+        oob = _lib.gs_windowed_reduce_i32o(
+            _i32ptr(s32), _i32ptr(d32), _i32ptr(v32), n, eb,
+            vbp, _REDUCE_OPS[name], _REDUCE_DIRS[direction],
+            _i32ptr(cells), _i32ptr(counts))
+    elif out_i32:
+        s64, d64 = np.ascontiguousarray(src), np.ascontiguousarray(dst)
+        v32 = np.ascontiguousarray(val)
+        oob = _lib.gs_windowed_reduce_i64i32o(
+            _i64ptr(s64), _i64ptr(d64), _i32ptr(v32), n, eb, vbp,
+            _REDUCE_OPS[name], _REDUCE_DIRS[direction],
+            _i32ptr(cells), _i32ptr(counts))
+    elif ids_i32 and val.dtype == np.int32:
+        s32, d32, v32 = (np.ascontiguousarray(a)
+                         for a in (src, dst, val))
         oob = _lib.gs_windowed_reduce_i32(
-            _i32ptr(src32), _i32ptr(dst32), _i32ptr(val32), n, eb,
+            _i32ptr(s32), _i32ptr(d32), _i32ptr(v32), n, eb,
             vbp, _REDUCE_OPS[name], _REDUCE_DIRS[direction],
             _i64ptr(cells), _i64ptr(counts))
     else:

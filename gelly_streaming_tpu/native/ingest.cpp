@@ -267,23 +267,24 @@ int64_t count_one_window(const int64_t* src, const int64_t* dst,
 
 namespace {
 
-template <int OP, bool SRC, bool DST, typename ID, typename VAL>
+template <int OP, bool SRC, bool DST, typename ID, typename VAL,
+          typename OUT>
 int64_t reduce_loop(const ID* src, const ID* dst, const VAL* val,
-                    int64_t n, int64_t eb, int64_t vbp, int64_t* cells,
-                    int64_t* counts) {
+                    int64_t n, int64_t eb, int64_t vbp, OUT* cells,
+                    OUT* counts) {
     int64_t oob = 0;   // out-of-range ids: counted, never written
     for (int64_t lo = 0, w = 0; lo < n; lo += eb, ++w) {
         const int64_t hi = (n - lo < eb) ? n : lo + eb;
-        int64_t* wc = cells + w * vbp;
-        int64_t* wn = counts + w * vbp;
+        OUT* wc = cells + w * vbp;
+        OUT* wn = counts + w * vbp;
         for (int64_t i = lo; i < hi; ++i) {
-            const int64_t v = static_cast<int64_t>(val[i]);
+            const OUT v = static_cast<OUT>(val[i]);
             if (SRC) {
                 // unsigned compare rejects negatives too
                 if (static_cast<uint64_t>(src[i])
                         >= static_cast<uint64_t>(vbp)) { ++oob; }
                 else {
-                    int64_t* c = wc + src[i];
+                    OUT* c = wc + src[i];
                     if (OP == 0) *c += v;
                     else if (OP == 1) { if (v < *c) *c = v; }
                     else { if (v > *c) *c = v; }
@@ -294,7 +295,7 @@ int64_t reduce_loop(const ID* src, const ID* dst, const VAL* val,
                 if (static_cast<uint64_t>(dst[i])
                         >= static_cast<uint64_t>(vbp)) { ++oob; }
                 else {
-                    int64_t* c = wc + dst[i];
+                    OUT* c = wc + dst[i];
                     if (OP == 0) *c += v;
                     else if (OP == 1) { if (v < *c) *c = v; }
                     else { if (v > *c) *c = v; }
@@ -306,23 +307,22 @@ int64_t reduce_loop(const ID* src, const ID* dst, const VAL* val,
     return oob;
 }
 
-template <typename ID, typename VAL>
+template <typename ID, typename VAL, typename OUT>
 int64_t reduce_dispatch(const ID* src, const ID* dst, const VAL* val,
                         int64_t n, int64_t eb, int64_t vbp, int32_t op,
-                        int32_t direction, int64_t* cells,
-                        int64_t* counts) {
+                        int32_t direction, OUT* cells, OUT* counts) {
     using Fn = int64_t (*)(const ID*, const ID*, const VAL*, int64_t,
-                           int64_t, int64_t, int64_t*, int64_t*);
+                           int64_t, int64_t, OUT*, OUT*);
     static const Fn table[3][3] = {
-        {reduce_loop<0, true, false, ID, VAL>,
-         reduce_loop<0, false, true, ID, VAL>,
-         reduce_loop<0, true, true, ID, VAL>},
-        {reduce_loop<1, true, false, ID, VAL>,
-         reduce_loop<1, false, true, ID, VAL>,
-         reduce_loop<1, true, true, ID, VAL>},
-        {reduce_loop<2, true, false, ID, VAL>,
-         reduce_loop<2, false, true, ID, VAL>,
-         reduce_loop<2, true, true, ID, VAL>},
+        {reduce_loop<0, true, false, ID, VAL, OUT>,
+         reduce_loop<0, false, true, ID, VAL, OUT>,
+         reduce_loop<0, true, true, ID, VAL, OUT>},
+        {reduce_loop<1, true, false, ID, VAL, OUT>,
+         reduce_loop<1, false, true, ID, VAL, OUT>,
+         reduce_loop<1, true, true, ID, VAL, OUT>},
+        {reduce_loop<2, true, false, ID, VAL, OUT>,
+         reduce_loop<2, false, true, ID, VAL, OUT>,
+         reduce_loop<2, true, true, ID, VAL, OUT>},
     };
     return table[op][direction](src, dst, val, n, eb, vbp, cells,
                                 counts);
@@ -353,6 +353,35 @@ int64_t gs_windowed_reduce_i32(const int32_t* src, const int32_t* dst,
                                int64_t eb, int64_t vbp, int32_t op,
                                int32_t direction, int64_t* cells,
                                int64_t* counts) {
+    return reduce_dispatch(src, dst, val, n, eb, vbp, op, direction,
+                           cells, counts);
+}
+
+// All-int32 form: int32 output slabs halve the faulted/written output
+// bytes and drop the Python-side astype copy entirely. Only safe when
+// the caller proves the worst-case cell sum fits int32 (the wrapper's
+// overflow bound, mirroring the numpy tier's exact_bincount guard) —
+// min/max outputs are input values, always safe for int32 inputs.
+int64_t gs_windowed_reduce_i32o(const int32_t* src, const int32_t* dst,
+                                const int32_t* val, int64_t n,
+                                int64_t eb, int64_t vbp, int32_t op,
+                                int32_t direction, int32_t* cells,
+                                int32_t* counts) {
+    return reduce_dispatch(src, dst, val, n, eb, vbp, op, direction,
+                           cells, counts);
+}
+
+// int64 ids + int32 values/outputs: the common bench/driver shape when
+// ids arrive un-interned (int64) — avoids both the caller-side id
+// downcast (two full min/max scans + casts) and the int64 output
+// slabs. Out-of-range ids (including any beyond int32) hit the
+// unsigned bound check and are reported, never wrapped.
+int64_t gs_windowed_reduce_i64i32o(const int64_t* src,
+                                   const int64_t* dst,
+                                   const int32_t* val, int64_t n,
+                                   int64_t eb, int64_t vbp, int32_t op,
+                                   int32_t direction, int32_t* cells,
+                                   int32_t* counts) {
     return reduce_dispatch(src, dst, val, n, eb, vbp, op, direction,
                            cells, counts);
 }

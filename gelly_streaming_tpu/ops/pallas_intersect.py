@@ -33,51 +33,89 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .pallas_triangles import _need_interpret
 
-TILE_E = 64      # edges per grid step: the [T, CHUNK_K, K] broadcast
-                 # compare materializes in VMEM, so T=64/Ck=128/K<=256
-                 # stays under the 16M scoped-vmem limit (T=256 OOMs)
-CHUNK_K = 128    # compare-chunk width (lane-aligned)
+TILE_E = 64      # default edges per grid step: the [T, CHUNK_K, K]
+                 # broadcast compare materializes in VMEM, so
+                 # T=64/Ck=128/K<=256 stays under the 16M scoped-vmem
+                 # limit (T=256 OOMs). The SHIPPED shape is a measured
+                 # selection — see _resolve_tile.
+CHUNK_K = 128    # default compare-chunk width (lane-aligned)
 MAX_TILES = 2048 # grid steps per pallas_call: the [g] partial vector
                  # lives wholly in SMEM (scarce scalar memory), so cap
                  # it at 8KB and slab larger edge buckets over several
                  # calls (each slab shape is identical -> one compile)
 
-
-def _intersect_kernel(ra, rb, va, out):
-    """ra/rb: [TILE_E, K] int32 neighbor rows; va: [TILE_E, K] bool
-    validity of ra entries (sentinel/padding pre-masked). out: [g]
-    int32 partial counts in SMEM — the whole array is the block (a
-    size-1 block per step is not lowerable on TPU), each grid step
-    writes its own slot."""
-    k = ra.shape[1]
-    rb_val = rb[:]                                # [T, K] in VMEM
-    total = jnp.int32(0)
-    for c in range(-(-k // CHUNK_K)):
-        ck = min(CHUNK_K, k - c * CHUNK_K)
-        a_chunk = ra[:, pl.ds(c * CHUNK_K, ck)]   # [T, Ck]
-        v_chunk = va[:, pl.ds(c * CHUNK_K, ck)]
-        hit = jnp.any(
-            a_chunk[:, :, None] == rb_val[:, None, :], axis=2)  # [T, Ck]
-        total += jnp.sum(jnp.where(hit & v_chunk, 1, 0),
-                         dtype=jnp.int32)
-    out[pl.program_id(0)] = total
+_TILE_CHOICE = None  # (tile_e, chunk_k), resolved once per process
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
+def _resolve_tile():
+    """The (TILE_E, CHUNK_K) shape intersect_local_pallas ships:
+    the best parity-true row of the committed chip tile sweep
+    (PERF.json `intersect.pallas_sweep`, tools/profile_kernels.py
+    section_intersect) when one exists, else the module defaults —
+    the same committed-evidence policy as every other kernel
+    selection."""
+    global _TILE_CHOICE
+    if _TILE_CHOICE is not None:
+        return _TILE_CHOICE
+    choice = (TILE_E, CHUNK_K)
+    try:
+        from .triangles import _load_tpu_perf
+
+        perf = _load_tpu_perf()
+        rows = [r for r in ((perf or {}).get("intersect", {})
+                            .get("pallas_sweep", []) or [])
+                if r.get("parity") is True and r.get("ms")
+                and r.get("tile_e") and r.get("chunk_k")]
+        if rows:
+            best = min(rows, key=lambda r: r["ms"])
+            choice = (int(best["tile_e"]), int(best["chunk_k"]))
+    except Exception:
+        pass
+    _TILE_CHOICE = choice
+    return choice
+
+
+def _make_kernel(chunk_k: int):
+    def _intersect_kernel(ra, rb, va, out):
+        """ra/rb: [T, K] int32 neighbor rows; va: [T, K] bool validity
+        of ra entries (sentinel/padding pre-masked). out: [g] int32
+        partial counts in SMEM — the whole array is the block (a
+        size-1 block per step is not lowerable on TPU), each grid step
+        writes its own slot."""
+        k = ra.shape[1]
+        rb_val = rb[:]                              # [T, K] in VMEM
+        total = jnp.int32(0)
+        for c in range(-(-k // chunk_k)):
+            ck = min(chunk_k, k - c * chunk_k)
+            a_chunk = ra[:, pl.ds(c * chunk_k, ck)]  # [T, Ck]
+            v_chunk = va[:, pl.ds(c * chunk_k, ck)]
+            hit = jnp.any(
+                a_chunk[:, :, None] == rb_val[:, None, :], axis=2)
+            total += jnp.sum(jnp.where(hit & v_chunk, 1, 0),
+                             dtype=jnp.int32)
+        out[pl.program_id(0)] = total
+
+    return _intersect_kernel
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("interpret", "tile_e", "chunk_k"))
 def _intersect_tiles(rows_a: jax.Array, rows_b: jax.Array,
-                     valid: jax.Array, interpret: bool) -> jax.Array:
+                     valid: jax.Array, interpret: bool,
+                     tile_e: int = TILE_E,
+                     chunk_k: int = CHUNK_K) -> jax.Array:
     ep, k = rows_a.shape
-    assert ep % TILE_E == 0, (ep, TILE_E)
-    g = ep // TILE_E
+    assert ep % tile_e == 0, (ep, tile_e)
+    g = ep // tile_e
     return pl.pallas_call(
-        _intersect_kernel,
+        _make_kernel(chunk_k),
         grid=(g,),
         in_specs=[
-            pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
+            pl.BlockSpec((tile_e, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
+            pl.BlockSpec((tile_e, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((TILE_E, k), lambda i: (i, 0),
+            pl.BlockSpec((tile_e, k), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         # One scalar per grid step. A PER-STEP size-1 output block
@@ -93,13 +131,20 @@ def _intersect_tiles(rows_a: jax.Array, rows_b: jax.Array,
 
 
 def intersect_local_pallas(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
-                           emask: jax.Array) -> jax.Array:
+                           emask: jax.Array, tile_e: int = None,
+                           chunk_k: int = None) -> jax.Array:
     """Drop-in for ops/triangles.intersect_local (same contract: count
-    of |N_out(a) ∩ N_out(b)| over all valid oriented edges)."""
+    of |N_out(a) ∩ N_out(b)| over all valid oriented edges). The tile
+    shape defaults to the committed chip sweep's winner
+    (_resolve_tile); the profiler passes explicit shapes to sweep."""
+    if tile_e is None or chunk_k is None:
+        rt, rc = _resolve_tile()
+        tile_e = rt if tile_e is None else tile_e
+        chunk_k = rc if chunk_k is None else chunk_k
     sentinel = nbr.shape[0] - 1
     ep = ea.shape[0]
-    slab_e = MAX_TILES * TILE_E
-    pad = (-ep) % (TILE_E if ep <= slab_e else slab_e)
+    slab_e = MAX_TILES * tile_e
+    pad = (-ep) % (tile_e if ep <= slab_e else slab_e)
     if pad:
         ea = jnp.concatenate([ea, jnp.full(pad, sentinel, ea.dtype)])
         eb = jnp.concatenate([eb, jnp.full(pad, sentinel, eb.dtype)])
@@ -110,6 +155,7 @@ def intersect_local_pallas(nbr: jax.Array, ea: jax.Array, eb: jax.Array,
         rows_a = nbr[ea[s:s + slab_e]]
         rows_b = nbr[eb[s:s + slab_e]]
         valid = (rows_a < sentinel) & emask[s:s + slab_e, None]
-        partials = _intersect_tiles(rows_a, rows_b, valid, interpret)
+        partials = _intersect_tiles(rows_a, rows_b, valid, interpret,
+                                    tile_e, chunk_k)
         total = total + jnp.sum(partials, dtype=jnp.int32)
     return total

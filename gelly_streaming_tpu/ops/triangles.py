@@ -421,49 +421,86 @@ def build_window_counter(vb: int, kb: int):
 # streaming fixed-shape engine: the whole window pipeline on device
 # ----------------------------------------------------------------------
 
-_STREAM_IMPL = None   # "device" | "host", resolved once per process
+_STREAM_IMPL = None    # cpu-backend tier, resolved once per process
+_STREAM_IMPL_EB = {}   # chip per-bucket tier (eb -> impl)
 
 
-def _resolve_stream_impl() -> str:
-    """Streaming-counter tier: the device (XLA) kernel by default; a
-    HOST tier only when (a) this process runs a CPU backend — on chip
-    the device kernel always stands — and (b) committed backend-matched
-    measurements (PERF.json `host_stream` section,
-    tools/profile_kernels.py) show that host form at parity and ≥5%
-    faster at EVERY measured bucket. Two host tiers compete under the
-    same rule: "native" (the C++ compact-forward counter,
-    native/ingest.cpp — needs `native_parity`/`native_edges_per_s`
-    rows AND a loadable library) beats "host" (the vectorized numpy
-    kernel, ops/host_triangles.py) when its committed rows also clear
-    the numpy tier by ≥5%. Same measured-default policy as the
-    dense/Pallas/intersect selections: the CPU fallback floor picks
-    the implementation that actually wins on a CPU, but only on
-    committed evidence."""
-    global _STREAM_IMPL
-    if _STREAM_IMPL is not None:
-        return _STREAM_IMPL
+def _pick_host_tier(rows) -> str:
+    """Shared tier scoring over committed `host_stream` rows: "host"
+    when the numpy kernel clears the device path at parity on every
+    row, upgraded to "native" when the C++ tier also clears both and
+    the library loads. "device" otherwise."""
     impl = "device"
+    if rows_clear_bar(rows, "host_edges_per_s",
+                      "device_edges_per_s"):
+        impl = "host"
+    if rows_clear_bar(rows, "native_edges_per_s",
+                      lambda r: max(
+                          r.get("device_edges_per_s") or 0,
+                          r.get("host_edges_per_s") or 0),
+                      parity_key="native_parity"):
+        from .. import native as _native
+
+        if _native.triangles_available():
+            impl = "native"
+    return impl
+
+
+def _resolve_stream_impl(eb: int = None) -> str:
+    """Streaming-counter tier: the device (XLA) kernel by default; a
+    HOST tier only on committed backend-matched measurements
+    (PERF.json `host_stream` section, tools/profile_kernels.py)
+    showing that form at parity and ≥5% faster. Two host tiers
+    compete under the same rule: "native" (the C++ compact-forward
+    counter, native/ingest.cpp — needs `native_parity`/
+    `native_edges_per_s` rows AND a loadable library) beats "host"
+    (the vectorized numpy kernel, ops/host_triangles.py) when its
+    committed rows also clear the numpy tier by ≥5%.
+
+    Backend scope differs deliberately:
+      - CPU backend: ONE process-wide tier from ALL committed cpu
+        rows (the fallback floor; unchanged since r3).
+      - TPU backend: per-EDGE-BUCKET routing from that bucket's own
+        chip-labeled rows (VERDICT r4 item 5 — the tunneled chip
+        loses outright at 8192-edge windows, 0.44× the numpy port,
+        because per-dispatch latency dominates small windows; a
+        measured sub-crossover bucket routes to the faster host tier
+        while other buckets keep the device path). `eb=None` on chip
+        always means "device" (no evidence consulted).
+    Same measured-default policy as the dense/Pallas/intersect
+    selections."""
+    global _STREAM_IMPL
     try:
         import jax as _jax
 
-        if _jax.default_backend() == "cpu":
+        backend = _jax.default_backend()
+    except Exception:
+        return "device"
+    if backend == "cpu":
+        if _STREAM_IMPL is not None:
+            return _STREAM_IMPL
+        impl = "device"
+        try:
             perf = _load_matching_perf("cpu")
-            rows = (perf or {}).get("host_stream", [])
-            if rows_clear_bar(rows, "host_edges_per_s",
-                              "device_edges_per_s"):
-                impl = "host"
-            if rows_clear_bar(rows, "native_edges_per_s",
-                              lambda r: max(
-                                  r.get("device_edges_per_s") or 0,
-                                  r.get("host_edges_per_s") or 0),
-                              parity_key="native_parity"):
-                from .. import native as _native
-
-                if _native.triangles_available():
-                    impl = "native"
+            impl = _pick_host_tier((perf or {}).get("host_stream", []))
+        except Exception:
+            pass
+        _STREAM_IMPL = impl
+        return impl
+    if eb is None:
+        return "device"
+    if eb in _STREAM_IMPL_EB:
+        return _STREAM_IMPL_EB[eb]
+    impl = "device"
+    try:
+        perf = _load_matching_perf()
+        rows = [r for r in (perf or {}).get("host_stream", [])
+                if r.get("edge_bucket") == eb]
+        if rows:
+            impl = _pick_host_tier(rows)
     except Exception:
         pass
-    _STREAM_IMPL = impl
+    _STREAM_IMPL_EB[eb] = impl
     return impl
 
 
@@ -827,7 +864,7 @@ class TriangleWindowKernel:
 
 
     def _run_stack_loop(self, num_w: int, make_chunk, recount) -> list:
-        """The ONE depth-2 pipelined chunk loop both wire formats run.
+        """The ONE pipelined chunk loop both wire formats run.
         `make_chunk(at, hi)` returns (args_tuple, n) — the padded
         device arguments for windows [at:hi] plus the real window
         count (the window axis of a ragged final chunk pads to a
@@ -835,12 +872,21 @@ class TriangleWindowKernel:
         O(log MAX_STREAM_WINDOWS) compiled programs); `recount(w)`
         exactly recounts window w when its hubs overflow K.
 
-        Dispatch is PIPELINED depth 2: jax enqueues asynchronously, so
-        the host pads + enqueues chunk i+1 while the device runs chunk
-        i, and only then materializes chunk i's [W]-scalar outputs —
-        overlap instead of pad→run→block→pad serialization (the d2h of
-        counts is tiny; the win is hiding host prep + dispatch latency
-        behind device compute)."""
+        Two overlap mechanisms stack here (VERDICT r4 item 2 — the
+        chip rate was pinned ~600K edges/s by serialized host work):
+
+        - a PRODUCER THREAD preps + enqueues the h2d of chunk i+1
+          while the main thread dispatches/awaits chunk i. Through the
+          tunneled chip a device_put is effectively synchronous
+          network time; in a worker thread (numpy copies and the PJRT
+          transfer both release the GIL) it runs concurrently with
+          device execution. Bounded queue (depth 2) caps host+HBM
+          footprint at two in-flight chunks. `GS_STREAM_PREFETCH=0`
+          forces the single-threaded form.
+        - dispatch stays PIPELINED depth 2: chunk i's [W]-scalar
+          outputs are materialized only after chunk i+1 is enqueued,
+          so the d2h round-trip of one chunk hides behind the next.
+        """
         counts: list = []
         pending = None  # (at, n, c_dev, o_dev)
 
@@ -851,14 +897,64 @@ class TriangleWindowKernel:
                 c[w] = recount(at + int(w))
             counts.extend(int(x) for x in c)
 
-        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
+        starts = list(range(0, num_w, self.MAX_STREAM_WINDOWS))
+
+        def prep(at):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
             args, n = make_chunk(at, hi)
-            c, o = self._stream_exec(args[0].shape[0])(
-                *[jnp.asarray(a) for a in args])
-            if pending is not None:
-                materialize(*pending)
-            pending = (at, n, c, o)
+            return at, n, [jnp.asarray(a) for a in args]
+
+        if len(starts) > 1 and os.environ.get(
+                "GS_STREAM_PREFETCH", "1") != "0":
+            import queue as _queue
+            import threading
+
+            q = _queue.Queue(maxsize=2)
+            stop = threading.Event()
+
+            def _put(item):
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.25)
+                        return True
+                    except _queue.Full:
+                        continue
+                return False
+
+            def producer():
+                try:
+                    for at in starts:
+                        if not _put(prep(at)):
+                            return
+                    _put(None)
+                except BaseException as e:  # surfaces in the consumer
+                    _put(e)
+
+            t = threading.Thread(target=producer, daemon=True,
+                                 name="gs-stream-prefetch")
+            t.start()
+            try:
+                while True:
+                    item = q.get()
+                    if item is None:
+                        break
+                    if isinstance(item, BaseException):
+                        raise item
+                    at, n, dev = item
+                    c, o = self._stream_exec(dev[0].shape[0])(*dev)
+                    if pending is not None:
+                        materialize(*pending)
+                    pending = (at, n, c, o)
+            finally:
+                stop.set()
+                t.join(timeout=5)
+        else:
+            for at in starts:
+                at, n, dev = prep(at)
+                c, o = self._stream_exec(dev[0].shape[0])(*dev)
+                if pending is not None:
+                    materialize(*pending)
+                pending = (at, n, c, o)
         if pending is not None:
             materialize(*pending)
         return counts
@@ -902,7 +998,7 @@ class TriangleWindowKernel:
         full-size zero streams). seg_ops.warm_stream_buckets is the
         shared body. A no-op when the numpy tier is selected — there
         is nothing to compile."""
-        if _resolve_stream_impl() in ("host", "native"):
+        if _resolve_stream_impl(self.eb) in ("host", "native"):
             return
         seg_ops.warm_stream_buckets(self)
 
@@ -919,7 +1015,7 @@ class TriangleWindowKernel:
         dst = np.asarray(dst, np.int32)
         if len(src) == 0:
             return []
-        impl = _resolve_stream_impl()
+        impl = _resolve_stream_impl(self.eb)
         if impl == "native":
             from .. import native as native_mod
 
@@ -962,7 +1058,7 @@ class TriangleWindowKernel:
         numpy tier under the same selection as count_stream."""
         if not windows:
             return []
-        impl = _resolve_stream_impl()
+        impl = _resolve_stream_impl(self.eb)
         if impl == "native":
             from .. import native as native_mod
 

@@ -608,3 +608,36 @@ def test_native_tier_selected_end_to_end(tmp_path, monkeypatch):
     finally:
         monkeypatch.undo()
         tri_ops._STREAM_IMPL = None
+
+
+def test_stream_prefetch_parity_and_error_propagation(monkeypatch):
+    """The producer-thread prefetch path (default) and the
+    single-threaded form (GS_STREAM_PREFETCH=0) return identical
+    counts in window order; a prep failure mid-stream surfaces as the
+    original exception, not a hang or a truncated result."""
+    kern = tri_ops.TriangleWindowKernel(edge_bucket=256,
+                                       vertex_bucket=128)
+    kern.MAX_STREAM_WINDOWS = 4   # many chunks: 16 windows -> 4 chunks
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, 128, 16 * 256).astype(np.int32)
+    dst = rng.integers(0, 128, 16 * 256).astype(np.int32)
+    got = kern._count_stream_device(src, dst)
+    monkeypatch.setenv("GS_STREAM_PREFETCH", "0")
+    assert kern._count_stream_device(src, dst) == got
+    monkeypatch.undo()
+
+    boom = RuntimeError("prep exploded")
+
+    def bad_chunk(at, hi):
+        if at >= 8:
+            raise boom
+        from gelly_streaming_tpu.ops import segment as seg
+        num_w, s, d, valid = seg.window_stack(src, dst, kern.eb,
+                                              sentinel=kern.vb)
+        sc, dc, vc, n = seg.pad_window_chunk(
+            s, d, valid, at, hi, kern.MAX_STREAM_WINDOWS, kern.eb,
+            kern.vb)
+        return (sc, dc, vc), n
+
+    with pytest.raises(RuntimeError, match="prep exploded"):
+        kern._run_stack_loop(16, bad_chunk, lambda w: 0)
