@@ -616,59 +616,16 @@ class StreamingAnalyticsDriver:
         results = []
         num_w = len(interned)
         scan_chunk = self._scan_chunk()
-        for at in range(0, num_w, scan_chunk):
-            chunk = interned[at:at + scan_chunk]
-            outs = {}
-            if run_scan and native_state is not None:
-                flat_s = np.concatenate(
-                    [s for _w, s, _d, _n in chunk])
-                flat_d = np.concatenate(
-                    [d for _w, _s, d, _n in chunk])
-                offs = np.zeros(len(chunk) + 1, np.int64)
-                offs[1:] = np.cumsum(
-                    [len(s) for _w, s, _d, _n in chunk])
-                prevs = (tuple(a.copy() if a is not None else None
-                               for a in native_state)
-                         if self.emit_deltas else None)
-                with self._step("snapshot_scan", len(flat_s)):
-                    outs = native.snapshot_windows(
-                        flat_s, flat_d, offs, self.vb, *native_state)
-                if prevs is not None:
-                    # changed-slot masks vs the previous window's
-                    # snapshot (row -1 = chunk-start carried state) —
-                    # the scan tier's mask semantics: raw values for
-                    # degrees/labels, the consumer-visible ODD flag
-                    # for the cover
-                    pd, pl, pc = prevs
-                    if "deg" in outs:
-                        outs["deg_chg"] = outs["deg"] != np.concatenate(
-                            [pd[None], outs["deg"][:-1]])
-                    if "labels" in outs:
-                        outs["labels_chg"] = (
-                            outs["labels"] != np.concatenate(
-                                [pl[None], outs["labels"][:-1]]))
-                    if "cover" in outs:
-                        odd = (outs["cover"][:, :self.vb]
-                               == outs["cover"][:, self.vb:])
-                        podd = (pc[:self.vb] == pc[self.vb:])[None]
-                        outs["cover_chg"] = odd != np.concatenate(
-                            [podd, odd[:-1]])
-                        outs["_odd_rows"] = odd  # reused at extraction
-            elif run_scan:
-                fn, wb = self._scan_fn(len(chunk))
-                s_w = np.full((wb, self.eb), vb, np.int32)
-                d_w = np.full((wb, self.eb), vb, np.int32)
-                valid = np.zeros((wb, self.eb), bool)
-                for i, (_ws, s, d, _nv) in enumerate(chunk):
-                    s_w[i, :len(s)] = s
-                    d_w[i, :len(d)] = d
-                    valid[i, :len(s)] = True
-                with self._step("snapshot_scan",
-                                sum(len(s) for _w, s, _d, _n in chunk)):
-                    carry, outs = fn(carry, jnp.asarray(s_w),
-                                     jnp.asarray(d_w),
-                                     jnp.asarray(valid))
-                    outs = {k: np.asarray(v) for k, v in outs.items()}
+        # Depth-2 pipeline over the DEVICE scan branch: the scan carry
+        # is a device array, so chunk i+1's dispatch needs only the
+        # un-materialized carry — chunk i's d2h + extraction + chunk-
+        # boundary bookkeeping run while the device executes chunk
+        # i+1. The consistency unit is unchanged: a chunk's mirrors/
+        # cursors/checkpoint still move only in its finalize, in chunk
+        # order; an exception mid-call still leaves the driver at the
+        # last FINALIZED chunk (resumable). The host/native tier stays
+        # synchronous — one core, nothing to overlap with.
+        def _finalize_chunk(at, chunk, outs):
             nv_chunk = chunk[-1][3]
             last = len(chunk) - 1
             for i, (wstart, s, d, nv) in enumerate(chunk):
@@ -758,6 +715,84 @@ class StreamingAnalyticsDriver:
                     and self.windows_done // self._ckpt_every
                     > prev_done // self._ckpt_every):
                 self._stage_ckpt()
+
+        pending = None  # (at, chunk, device outs)
+
+        def finalize_pending():
+            nonlocal pending
+            if pending is None:
+                return
+            f_at, f_chunk, f_outs = pending
+            pending = None
+            with self._step("snapshot_wait",
+                            sum(len(s) for _w, s, _d, _n in f_chunk)):
+                f_outs = {k: np.asarray(v) for k, v in f_outs.items()}
+            _finalize_chunk(f_at, f_chunk, f_outs)
+
+        for at in range(0, num_w, scan_chunk):
+            chunk = interned[at:at + scan_chunk]
+            outs = {}
+            if run_scan and native_state is not None:
+                flat_s = np.concatenate(
+                    [s for _w, s, _d, _n in chunk])
+                flat_d = np.concatenate(
+                    [d for _w, _s, d, _n in chunk])
+                offs = np.zeros(len(chunk) + 1, np.int64)
+                offs[1:] = np.cumsum(
+                    [len(s) for _w, s, _d, _n in chunk])
+                prevs = (tuple(a.copy() if a is not None else None
+                               for a in native_state)
+                         if self.emit_deltas else None)
+                with self._step("snapshot_scan", len(flat_s)):
+                    outs = native.snapshot_windows(
+                        flat_s, flat_d, offs, self.vb, *native_state)
+                if prevs is not None:
+                    # changed-slot masks vs the previous window's
+                    # snapshot (row -1 = chunk-start carried state) —
+                    # the scan tier's mask semantics: raw values for
+                    # degrees/labels, the consumer-visible ODD flag
+                    # for the cover
+                    pd, pl, pc = prevs
+                    if "deg" in outs:
+                        outs["deg_chg"] = outs["deg"] != np.concatenate(
+                            [pd[None], outs["deg"][:-1]])
+                    if "labels" in outs:
+                        outs["labels_chg"] = (
+                            outs["labels"] != np.concatenate(
+                                [pl[None], outs["labels"][:-1]]))
+                    if "cover" in outs:
+                        odd = (outs["cover"][:, :self.vb]
+                               == outs["cover"][:, self.vb:])
+                        podd = (pc[:self.vb] == pc[self.vb:])[None]
+                        outs["cover_chg"] = odd != np.concatenate(
+                            [podd, odd[:-1]])
+                        outs["_odd_rows"] = odd  # reused at extraction
+            elif run_scan:
+                fn, wb = self._scan_fn(len(chunk))
+                s_w = np.full((wb, self.eb), vb, np.int32)
+                d_w = np.full((wb, self.eb), vb, np.int32)
+                valid = np.zeros((wb, self.eb), bool)
+                for i, (_ws, s, d, _nv) in enumerate(chunk):
+                    s_w[i, :len(s)] = s
+                    d_w[i, :len(d)] = d
+                    valid[i, :len(s)] = True
+                with self._step("snapshot_scan",
+                                sum(len(s) for _w, s, _d, _n in chunk)):
+                    # async dispatch: returns device arrays without
+                    # blocking; the d2h lands in this chunk's finalize
+                    # (snapshot_wait), AFTER the next chunk is queued
+                    carry, outs = fn(carry, jnp.asarray(s_w),
+                                     jnp.asarray(d_w),
+                                     jnp.asarray(valid))
+                finalize_pending()
+                pending = (at, chunk, outs)
+                continue
+            # only the device-scan branch (which `continue`s above)
+            # ever sets `pending`, and branch selection is fixed for
+            # the whole call — the sync tiers never have one in flight
+            assert pending is None
+            _finalize_chunk(at, chunk, outs)
+        finalize_pending()
         return results
 
     def _stage_ckpt(self) -> None:

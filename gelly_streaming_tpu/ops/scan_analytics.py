@@ -84,9 +84,11 @@ class SummaryEngineBase:
     """Shared scaffolding of the single-chip and sharded fused scan
     engines: carried-state reset/snapshot, the chunk loop, the
     partial-window-must-be-final guard, and summary assembly.
-    Subclasses provide `_dispatch` (run one [W, eb] chunk, returning
-    the summary tuple with overflow flags last) and `_redo` (exact
-    triangle recount of one overflowing window)."""
+    Subclasses provide `_dispatch_async` (enqueue one [W, eb] chunk
+    against the device-resident carry, returning raw un-materialized
+    outputs), `_materialize` (d2h those outputs into the writable
+    summary tuple (mdeg, ncomp, odd, tri, b_ovf, k_ovf)), and `_redo`
+    (exact triangle recount of one overflowing window)."""
 
     MAX_WINDOWS = 64
 
@@ -104,7 +106,16 @@ class SummaryEngineBase:
         odd = cover[: self.vb] == cover[self.vb + 1: 2 * self.vb + 1]
         return deg[: self.vb], labels[: self.vb], odd
 
-    def _dispatch(self, s, d, valid):
+    def _dispatch_async(self, s, d, valid):
+        """Enqueue one chunk (updating the device-resident carry) and
+        return the raw per-window outputs WITHOUT materializing them —
+        process()'s depth-2 pipeline defers the d2h to _materialize so
+        it overlaps the next chunk's execution."""
+        raise NotImplementedError
+
+    def _materialize(self, raw):
+        """d2h one chunk's raw outputs into writable numpy arrays
+        (mdeg, ncomp, odd, tri, b_ovf, k_ovf)."""
         raise NotImplementedError
 
     def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
@@ -137,6 +148,28 @@ class SummaryEngineBase:
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
                                                   sentinel=self.vb)
         out = []
+        # depth-2 pipeline: the scan carry stays device-resident, so
+        # chunk i+1 dispatches before chunk i's d2h + extraction —
+        # host work hides behind device execution (same discipline as
+        # the driver's _run_batched and the triangle _run_stack_loop)
+        pending = None  # (at, real, raw device outputs)
+
+        def finalize(f_at, f_real, raw):
+            mdeg, ncomp, odd, tri, b_ovf, k_ovf = (
+                x[:f_real] for x in self._materialize(raw))
+            for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
+                lo = (f_at + int(w)) * self.eb
+                tri[w] = self._redo(src[lo:lo + self.eb],
+                                    dst[lo:lo + self.eb],
+                                    int(b_ovf[w]), int(k_ovf[w]))
+            for w in range(f_real):
+                out.append({
+                    "max_degree": int(mdeg[w]),
+                    "num_components": int(ncomp[w]),
+                    "odd_cycle": bool(odd[w]),
+                    "triangles": int(tri[w]),
+                })
+
         for at in range(0, num_w, self.MAX_WINDOWS):
             hi = min(at + self.MAX_WINDOWS, num_w)
             # ragged tails pad the window axis to a power-of-two bucket
@@ -144,20 +177,12 @@ class SummaryEngineBase:
             # varying stream lengths reuse O(log MAX_WINDOWS) programs
             sc, dc, vc, real = seg_ops.pad_window_chunk(
                 s, d, valid, at, hi, self.MAX_WINDOWS, self.eb, self.vb)
-            mdeg, ncomp, odd, tri, b_ovf, k_ovf = (
-                x[:real] for x in self._dispatch(sc, dc, vc))
-            for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
-                lo = (at + int(w)) * self.eb
-                tri[w] = self._redo(src[lo:lo + self.eb],
-                                    dst[lo:lo + self.eb],
-                                    int(b_ovf[w]), int(k_ovf[w]))
-            for w in range(hi - at):
-                out.append({
-                    "max_degree": int(mdeg[w]),
-                    "num_components": int(ncomp[w]),
-                    "odd_cycle": bool(odd[w]),
-                    "triangles": int(tri[w]),
-                })
+            raw = self._dispatch_async(sc, dc, vc)
+            if pending is not None:
+                finalize(*pending)
+            pending = (at, real, raw)
+        if pending is not None:
+            finalize(*pending)
         return out
 
 
@@ -192,14 +217,16 @@ class StreamSummaryEngine(SummaryEngineBase):
             k_bucket=4 * self.kb)
         self.reset()
 
-    def _dispatch(self, s, d, valid):
-        self._carry, (mdeg, ncomp, odd, tri, ovf) = self._run(
+    def _dispatch_async(self, s, d, valid):
+        self._carry, outs = self._run(
             self._carry, jnp.asarray(s), jnp.asarray(d),
             jnp.asarray(valid))
+        return outs
+
+    def _materialize(self, raw):
+        mdeg, ncomp, odd, tri, ovf = (np.array(x) for x in raw)
         # single-chip scan has one overflow signal: report it as k_ovf
-        zeros = np.zeros_like(np.array(ovf))
-        return (*(np.array(x) for x in (mdeg, ncomp, odd, tri)),
-                zeros, np.array(ovf))
+        return mdeg, ncomp, odd, tri, np.zeros_like(ovf), ovf
 
     def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
         return self._tri_fallback.count(src, dst)

@@ -1064,7 +1064,7 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
             table=self._tri.table)
         self.reset()
 
-    def _dispatch(self, s, d, valid):
+    def _dispatch_async(self, s, d, valid):
         from jax.sharding import NamedSharding
 
         sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
@@ -1073,7 +1073,10 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
             jax.device_put(s, sharding),
             jax.device_put(d, sharding),
             jax.device_put(valid, sharding))
-        return tuple(np.array(x) for x in res)
+        return res
+
+    def _materialize(self, raw):
+        return tuple(np.array(x) for x in raw)
 
     def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
         return self._tri.count(
