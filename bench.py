@@ -523,19 +523,39 @@ def run_reduce_leg(metric_suffix: str = "") -> None:
     from gelly_streaming_tpu.ops.windowed_reduce import (
         _resolve_reduce_impl)
 
+    tier = _resolve_reduce_impl("sum")
+    device_path_rate = None
+    if tier != "device":
+        # decomposition row: the raw device segment-kernel path (one
+        # warm + one timed rep), parity-checked against the routed
+        # tier's already-verified windows like the triangles leg
+        dev = eng._device_process_stream(src.astype(np.int64),
+                                         dst.astype(np.int64), val)
+        for (cells, _cnt), want in zip(dev, base):
+            np.testing.assert_array_equal(
+                cells[:num_vertices].astype(np.int64), want)
+        t0 = time.perf_counter()
+        eng._device_process_stream(src.astype(np.int64),
+                                   dst.astype(np.int64), val)
+        device_path_rate = num_edges / (time.perf_counter() - t0)
+
     print(json.dumps({
         "metric": "edges/sec/chip, windowed reduceOnEdges "
                   "sum-of-weights (power-law stream, %d-edge "
                   "windows)%s" % (window_edges, metric_suffix),
         "value": round(rate),
         "unit": "edges/s",
-        "tier": _resolve_reduce_impl("sum"),
+        "tier": tier,
         "vs_baseline": round(rate / cpu_rate, 2),
         "baseline_cpu_edges_per_s": round(cpu_rate),
         # secondary: the port made contract-equal (values AND counts)
         "baseline_cpu_with_counts_edges_per_s": round(cpu_rate_counts),
         "vs_baseline_with_counts": round(rate / cpu_rate_counts, 2),
         "num_edges": num_edges,
+        **({"device_path_edges_per_s": round(device_path_rate),
+            "device_path_vs_baseline": round(
+                device_path_rate / cpu_rate, 2)}
+           if device_path_rate is not None else {}),
     }), flush=True)
 
 

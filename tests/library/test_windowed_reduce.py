@@ -267,3 +267,44 @@ def test_window_chunking_boundaries():
     for (gc, gn), (wc, wn) in zip(got, want):
         np.testing.assert_array_equal(gc[:nv], wc)
         np.testing.assert_array_equal(gn[:nv], wn)
+
+
+@needs_native_reduce
+def test_reduce_tier_chip_routing_on_chip_labeled_rows(tmp_path,
+                                                       monkeypatch):
+    """A TPU-backend process consults chip-labeled host_reduce rows
+    (the in-window section measures the tunnel host's tiers): winning
+    rows route the engine off the device path; cpu-labeled rows never
+    do (VERDICT r4 item 4)."""
+    import json
+
+    import jax
+
+    from gelly_streaming_tpu.ops import triangles as tri_ops
+    from gelly_streaming_tpu.ops import windowed_reduce as wr
+
+    perf = tmp_path / "PERF.json"
+    monkeypatch.setattr(tri_ops, "_PERF_PATH", str(perf))
+    monkeypatch.setattr(wr, "_REDUCE_IMPL", {})
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    rows = [{"name": "sum", "edge_bucket": 8192, "parity": True,
+             "host_edges_per_s": 60_000_000,
+             "device_edges_per_s": 200_000,
+             "native_parity": True,
+             "native_edges_per_s": 120_000_000}]
+    try:
+        perf.write_text(json.dumps(
+            {"backend": "tpu", "host_reduce": rows}))
+        assert wr._resolve_reduce_impl("sum") == "native"
+        assert wr._resolve_reduce_impl(
+            "sum", allow_native=False) == "host"
+        # unmeasured monoid keeps the device path
+        assert wr._resolve_reduce_impl("min") == "device"
+        # the same rows labeled cpu must not drive a chip process
+        wr._REDUCE_IMPL.clear()
+        perf.write_text(json.dumps(
+            {"backend": "cpu", "host_reduce": rows}))
+        assert wr._resolve_reduce_impl("sum") == "device"
+    finally:
+        monkeypatch.undo()
+        wr._REDUCE_IMPL.clear()
