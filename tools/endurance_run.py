@@ -20,7 +20,8 @@ steady-state tail must be compile-free), and end-of-stream invariants
 (windows_done * window size == edges_done == NUM_EDGES; sum(degrees)
 == 2 * edges folded since the degree vector's birth).
 
-Emits one JSON line per phase and writes ENDURANCE_r04.json.
+Emits one JSON line per phase and writes ENDURANCE_r05.json
+(override with --out).
 CPU-fallback friendly: backend is whatever jax picks (the claim under
 test is the host-side streaming discipline, not chip speed).
 """
@@ -167,12 +168,22 @@ def main():
     import argparse
 
     ap = argparse.ArgumentParser()
-    ap.add_argument("--fixture", default="/tmp/gs_endurance.txt")
+    # size-keyed default: the run streams the WHOLE fixture file, so a
+    # larger cached fixture from a previous (e.g. 100M) run would make
+    # a scaled-down GS_END_EDGES rerun process the big stream and fail
+    # its total-window asserts
+    ap.add_argument("--fixture",
+                    default="/tmp/gs_endurance_%d.txt" % NUM_EDGES)
     ap.add_argument("--out", default=os.path.join(
-        REPO, "ENDURANCE_r04.json"))
+        REPO, "ENDURANCE_r05.json"))
     args = ap.parse_args()
-    if not os.path.exists(args.fixture) or \
-            os.path.getsize(args.fixture) < NUM_EDGES * 10:
+    # regenerate when missing, too small, OR far larger than this
+    # run expects: the tool streams the WHOLE file, so an oversized
+    # cached fixture (e.g. a 100M-edge file passed explicitly to a
+    # scaled-down run) would fail the total-window asserts hours in
+    size = (os.path.getsize(args.fixture)
+            if os.path.exists(args.fixture) else 0)
+    if not (NUM_EDGES * 10 <= size <= NUM_EDGES * 40):
         generate(args.fixture)
     run(args.fixture, args.out)
 
