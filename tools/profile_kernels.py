@@ -916,11 +916,13 @@ def section_ingress_ab(results: dict) -> None:
     import jax
     import jax.numpy as jnp
 
-    from tools.ingress_ab import h2d_probe, latency_probe, stream_ab
+    from tools.ingress_ab import (device_compute_probe, h2d_probe,
+                                  latency_probe, stream_ab)
 
     probes, ab = [], []
     latency_probe(jax, jnp, probes)
     h2d_probe(jax, jnp, 32768, 16, probes)
+    device_compute_probe(jax, jnp, probes)
     stream_ab(jax, jnp, int(os.environ.get("GS_AB_EDGES", 2_097_152)),
               ab)
     results["ingress_probes"] = probes
