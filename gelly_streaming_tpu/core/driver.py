@@ -44,6 +44,27 @@ from ..utils.interning import make_interner
 from ..utils.tracing import StepTimer
 
 
+def _snapshot_view(a: np.ndarray, row_size: int = 0) -> np.ndarray:
+    """Read-only array with snapshot semantics. When the slice covers
+    most of its backing row (steady state: nv ≈ vb — exactly when the
+    per-window copy is what costs, ~20% of the 10M-edge driver leg's
+    host time), return a frozen VIEW: the backing stack is fresh per
+    chunk and never written after extraction (the device scan's
+    outputs are already immutable; the native tier's are np.empty
+    slabs the kernel filled before extraction). When the slice is
+    small relative to `row_size` (early stream), return an owned copy
+    instead — a tiny window's field must not pin its chunk's whole
+    [W, vb] stack in memory for consumers that retain results. Every
+    returned array is read-only, so the 'fields are snapshots, never
+    live state' contract is uniform across tiers and paths."""
+    if row_size and 4 * a.size < row_size:
+        a = a.copy()
+    else:
+        a = a[:]
+    a.flags.writeable = False
+    return a
+
+
 def _build_snapshot_scan(vb: int, analytics: tuple,
                          deltas: bool = False):
     """One jitted lax.scan over a [W, eb] window stack, carrying
@@ -153,7 +174,11 @@ def resolve_snapshot_tier() -> str:
 @dataclasses.dataclass
 class WindowResult:
     """Per-window analytics snapshot. Vertex-indexed arrays are in dense
-    slot order; `vertex_ids[slot]` maps back to external ids."""
+    slot order; `vertex_ids[slot]` maps back to external ids.
+
+    Array fields are READ-ONLY snapshots (often zero-copy views of the
+    chunk's output stacks — _snapshot_view); consumers that need a
+    mutable array call `.copy()`."""
 
     window_start: int
     num_edges: int
@@ -641,7 +666,8 @@ class StreamingAnalyticsDriver:
                             outs["deg_chg"][i][:nv])[0].astype(np.int32)
                         res.delta_degrees = (idx, snap[idx])
                 if "labels" in outs:
-                    res.cc_labels = outs["labels"][i][:nv].copy()
+                    res.cc_labels = _snapshot_view(
+                        outs["labels"][i][:nv], self.vb)
                     if "labels_chg" in outs:
                         idx = np.nonzero(
                             outs["labels_chg"][i][:nv])[0].astype(
@@ -650,7 +676,8 @@ class StreamingAnalyticsDriver:
                 if "cover" in outs:
                     if "_odd_rows" in outs:  # native delta path: the
                         # odd matrix was already computed for the mask
-                        res.bipartite_odd = outs["_odd_rows"][i][:nv].copy()
+                        res.bipartite_odd = _snapshot_view(
+                            outs["_odd_rows"][i][:nv], self.vb)
                     else:
                         plus = outs["cover"][i][:vb]
                         minus = outs["cover"][i][vb:2 * vb]
@@ -875,8 +902,10 @@ class StreamingAnalyticsDriver:
             fresh = np.asarray(self.interner.ids_of(
                 np.arange(have, nv, dtype=np.int32)))
             self._ext_ids = np.concatenate([self._ext_ids, fresh])
-        # copy: WindowResult fields are snapshots, never live views
-        return self._ext_ids[:nv].copy()
+        # read-only view: the cache only ever grows by REALLOCATION
+        # (np.concatenate), so an earlier window's view keeps pointing
+        # at its own immutable snapshot of the table
+        return _snapshot_view(self._ext_ids[:nv])
 
     def _prev_snapshots(self) -> dict:
         """Previous-window snapshot values for host-side delta diffing
@@ -1023,7 +1052,7 @@ class StreamingAnalyticsDriver:
                 snap = np.asarray(self._deg_state)[:nv].astype(np.int64)
                 self._check_degree_width(snap)
                 self._degrees = snap  # host mirror: checkpoint source
-                res.degrees = snap.copy()
+                res.degrees = _snapshot_view(snap.copy())
         elif name == "cc":
             if sharded:
                 res.cc_labels = np.array(self._engine.cc_labels(s, d)[:nv])
@@ -1035,7 +1064,7 @@ class StreamingAnalyticsDriver:
                 self._cc = unionfind.connected_components_with_labels(
                     s, d, self._cc, nv, vertex_bucket=self.vb,
                     edge_bucket=self.eb)
-                res.cc_labels = self._cc.copy()
+                res.cc_labels = _snapshot_view(self._cc.copy())
         elif name == "bipartite":
             if sharded:
                 _, _, odd = self._engine.bipartite(s, d)
