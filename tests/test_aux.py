@@ -120,3 +120,36 @@ def test_time_units_complete():
         == 3_600_000
     assert Time.days(1).milliseconds == Time.of(24, "hours").milliseconds
     assert Time.seconds(3).milliseconds == Time.of(3000).milliseconds
+
+
+def test_ingress_ab_parity_failure_is_evidence_not_a_crash(monkeypatch):
+    """ADVICE r4: a parity failure between wire formats must commit a
+    {parity: false} row (which rows_clear_bar rejects, so compact
+    ingress is never adopted on it) instead of crashing the tool and
+    losing the profiler section's probe rows."""
+    import jax
+    import jax.numpy as jnp
+
+    from tools import ingress_ab as ab
+    from gelly_streaming_tpu.ops import triangles as tri
+
+    class FakeKernel:
+        def __init__(self, edge_bucket, vertex_bucket, ingress):
+            self.kb = 32
+            self.MAX_STREAM_WINDOWS = 4
+            self.ingress = ingress
+
+        def warm_chunks(self):
+            pass
+
+        def _count_stream_device(self, src, dst):
+            # formats disagree: one count differs
+            return [1, 2] if self.ingress == "standard" else [1, 3]
+
+    monkeypatch.setattr(tri, "TriangleWindowKernel", FakeKernel)
+    results = []
+    ab.stream_ab(jax, jnp, 1024, results)
+    (row,) = results
+    assert row["parity"] is False
+    assert "speedup" not in row
+    assert not tri.rows_clear_bar([row], "speedup", lambda r: 1.0)
