@@ -124,6 +124,13 @@ def test_compact_stream_counts_match_device_path(monkeypatch):
     k_cmp = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
                                  ingress="compact")
     assert k_cmp._count_stream_device(src, dst) == std
+    # multi-chunk form: 10 windows through 3-window chunks exercises
+    # the prefetch producer thread + ragged-tail padding on the
+    # COMPACT wire format (the single-chunk default skips the thread)
+    k_cmp.MAX_STREAM_WINDOWS = 3
+    assert k_cmp._count_stream_device(src, dst) == std
+    k_cmp.MAX_STREAM_WINDOWS = _tuned = TriangleWindowKernel(
+        edge_bucket=eb, vertex_bucket=vb).MAX_STREAM_WINDOWS
     windows = [(src[s:s + eb], dst[s:s + eb])
                for s in range(0, len(src), eb)]
     assert k_cmp.count_windows(windows) == std
