@@ -52,9 +52,10 @@ def test_doc_file_citations_resolve():
         cited |= set(re.findall(r"\b(tests/[a-z0-9_/]+\.py)\b", text))
         cited |= set(re.findall(r"\b(test_[a-z0-9_]+\.py)\b", text))
         for c in sorted(cited):
-            # driver-produced per-round artifacts may not exist yet
-            # (BENCH_r02.json lands at end of round)
-            if re.match(r"(BENCH|MULTICHIP)_r(\{?N\}?|\d+)",
+            # driver/queue-produced per-round artifacts may not exist
+            # yet (BENCH_r02.json lands at end of round;
+            # BENCH_chip_rNN.json is the queue's in-window snapshot)
+            if re.match(r"(BENCH|MULTICHIP)(_chip)?_r(\{?N\}?|NN|\d+)",
                         os.path.basename(c)):
                 continue
             if not _exists_somewhere(c):
