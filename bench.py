@@ -407,6 +407,24 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     assert list(timed_counts[:nfull]) == full_counts, (
         list(timed_counts[:nfull]), full_counts)
 
+    # the same routed path with the ingress pipeline FORCED
+    # SYNCHRONOUS (single-threaded prep, no worker pool): the A/B the
+    # pipelined-host-ingress work is accountable to, with exact
+    # window-by-window parity asserted — identical counts are part of
+    # the pipeline's contract, not a sampling check
+    from gelly_streaming_tpu.ops import ingress_pipeline
+
+    ts = []
+    for _ in range(reps):
+        with ingress_pipeline.forced_sync():
+            t0 = time.perf_counter()
+            sync_counts = device_window_counts(kernel, src, dst,
+                                               window_edges)
+            ts.append(time.perf_counter() - t0)
+    sync_rate = num_edges / float(np.median(ts))
+    assert list(sync_counts) == list(timed_counts), \
+        "pipelined path diverged from sync host-prep path"
+
     device_path_rate = None
     if tier != "device":
         # decomposition row: the raw device/chip path at this scale,
@@ -445,6 +463,13 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
             round(cpu_np_sample_rate),
         "baseline_cpu_python_edges_per_s": round(cpu_py_rate),
         "vs_python_baseline": round(rate / cpu_py_rate, 2),
+        # the ingress-pipeline A/B: the routed path with parallel
+        # window prep + overlapped h2d/dispatch (the headline `value`)
+        # vs the same path forced single-threaded-synchronous,
+        # identical counts asserted window-by-window above
+        "sync_prep_edges_per_s": round(sync_rate),
+        "pipeline_speedup": round(rate / sync_rate, 2),
+        "pipeline_workers": ingress_pipeline.worker_count(),
         "num_edges": num_edges,
     }
     if device_path_rate is not None:

@@ -36,11 +36,12 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import native
+from ..ops import ingress_pipeline
 from ..ops import segment as seg_ops
 from ..ops import triangles as tri_ops
 from ..ops import unionfind
 from ..utils import checkpoint
-from ..utils.interning import make_interner
+from ..utils.interning import make_interner, parallel_intern_arrays
 from ..utils.tracing import StepTimer
 
 
@@ -63,6 +64,16 @@ def _snapshot_view(a: np.ndarray, row_size: int = 0) -> np.ndarray:
         a = a[:]
     a.flags.writeable = False
     return a
+
+
+def _frozen_delta(idx: np.ndarray, vals: np.ndarray) -> tuple:
+    """Freeze a (changed ids, new values) delta pair — the delta
+    streams share the WindowResult read-only snapshot contract (both
+    arrays are fresh fancy-indexed copies, never aliases of carried
+    state, so freezing costs nothing)."""
+    idx.flags.writeable = False
+    vals.flags.writeable = False
+    return (idx, vals)
 
 
 def _build_snapshot_scan(vb: int, analytics: tuple,
@@ -176,9 +187,15 @@ class WindowResult:
     """Per-window analytics snapshot. Vertex-indexed arrays are in dense
     slot order; `vertex_ids[slot]` maps back to external ids.
 
-    Array fields are READ-ONLY snapshots (often zero-copy views of the
-    chunk's output stacks — _snapshot_view); consumers that need a
-    mutable array call `.copy()`."""
+    EVERY array field is a READ-ONLY snapshot — vertex_ids, degrees,
+    cc_labels, bipartite_odd, and the arrays inside the delta_*
+    tuples, uniformly across tiers (device scan / native / sharded)
+    and dispatch paths (batched / per-window). They are often
+    zero-copy views of the chunk's output stacks (_snapshot_view) and
+    never alias live carried state, so consecutive windows' snapshots
+    are independently stable; consumers that need a mutable array
+    call `.copy()`. The contract is documented in README.md
+    ("WindowResult snapshots are read-only")."""
 
     window_start: int
     num_edges: int
@@ -527,25 +544,32 @@ class StreamingAnalyticsDriver:
         return min(self._SCAN_CHUNK,
                    tri_ops.capped_chunk(self.eb, "snapshot_scan"))
 
-    def _scan_fn(self, num_w: int):
-        """Jitted snapshot scan for the current buckets, cached per
-        (vb, eb, analytics, W-bucket) — O(log) programs total. A
-        W-bucket with no compiled program reuses the smallest
-        already-compiled LARGER bucket instead (sentinel window rows
-        are no-ops, outputs are read per real row), so a long stream's
-        ragged final chunk never compiles at the tail
+    def _scan_wb(self, num_w: int) -> int:
+        """The W-bucket the snapshot scan will run `num_w` windows at
+        — the bucket selection WITHOUT building a program, so the
+        ingress pipeline's prep worker can size a chunk's stacks off
+        the main thread. A W-bucket with no compiled program reuses
+        the smallest already-compiled LARGER bucket instead (sentinel
+        window rows are no-ops, outputs are read per real row), so a
+        long stream's ragged final chunk never compiles at the tail
         (tools/endurance_run.py's steady-state assert); right-sized
         programs still compile for callers whose FIRST batch is small
         (the per-window dispatch mode)."""
         wb = seg_ops.bucket_size(min(num_w, self._scan_chunk()))
-        key = (self.vb, self.eb, self.analytics, wb)
-        if getattr(self, "_scan_cache_key", None) != key[:3]:
+        key3 = (self.vb, self.eb, self.analytics)
+        if getattr(self, "_scan_cache_key", None) != key3:
             self._scan_cache = {}
-            self._scan_cache_key = key[:3]
+            self._scan_cache_key = key3
         if wb not in self._scan_cache:
             bigger = [b for b in self._scan_cache if b > wb]
             if bigger:
                 wb = min(bigger)
+        return wb
+
+    def _scan_fn_at(self, wb: int):
+        """Jitted snapshot scan for exactly W-bucket `wb` (selection
+        already applied by _scan_wb), cached per
+        (vb, eb, analytics, W-bucket) — O(log) programs total."""
         if wb not in self._scan_cache:
             if self.mesh is not None:
                 from ..parallel.sharded import make_sharded_snapshot_scan
@@ -556,7 +580,7 @@ class StreamingAnalyticsDriver:
             else:
                 self._scan_cache[wb] = _build_snapshot_scan(
                     self.vb, self.analytics, deltas=self.emit_deltas)
-        return self._scan_cache[wb], wb
+        return self._scan_cache[wb]
 
     def _run_batched(self, windows,
                      closes_partial: bool = False) -> List[WindowResult]:
@@ -574,13 +598,24 @@ class StreamingAnalyticsDriver:
         mirrors."""
         import jax.numpy as jnp
 
-        # intern everything first: buckets grow ONCE for the call
-        interned = []
-        for wstart, src, dst in windows:
-            with self._step("intern", 2 * len(src)):
-                s = self.interner.intern_array(src)
-                d = self.interner.intern_array(dst)
-            interned.append((wstart, s, d, len(self.interner)))
+        # intern everything first: buckets grow ONCE for the call. The
+        # per-element hash-map work rides the ingress prep pool
+        # (utils/interning.parallel_intern_arrays: first-occurrence
+        # uniques + dense scatter run parallel, slot ASSIGNMENT stays
+        # sequential over the tiny unique lists — slots are identical
+        # to the sequential loop at every pool size); sizes[] gives
+        # each window's post-intern vertex cursor for snapshot slicing
+        total_edges = sum(len(s) for _w, s, _d in windows)
+        with self._step("intern", 2 * total_edges):
+            flat = []
+            for _wstart, src, dst in windows:
+                flat.append(src)
+                flat.append(dst)
+            dense, sizes = parallel_intern_arrays(self.interner, flat)
+        interned = [
+            (windows[i][0], dense[2 * i], dense[2 * i + 1],
+             sizes[2 * i + 1])
+            for i in range(len(windows))]
         nv_final = len(self.interner)
         max_len = max(len(s) for _w, s, _d, _n in interned)
         self._ensure_buckets(nv_final, max_len)
@@ -660,11 +695,11 @@ class StreamingAnalyticsDriver:
                 if "deg" in outs:
                     snap = outs["deg"][i][:nv].astype(np.int64)
                     self._check_degree_width(snap)
-                    res.degrees = snap
+                    res.degrees = _snapshot_view(snap)
                     if "deg_chg" in outs:
                         idx = np.nonzero(
                             outs["deg_chg"][i][:nv])[0].astype(np.int32)
-                        res.delta_degrees = (idx, snap[idx])
+                        res.delta_degrees = _frozen_delta(idx, snap[idx])
                 if "labels" in outs:
                     res.cc_labels = _snapshot_view(
                         outs["labels"][i][:nv], self.vb)
@@ -672,7 +707,8 @@ class StreamingAnalyticsDriver:
                         idx = np.nonzero(
                             outs["labels_chg"][i][:nv])[0].astype(
                                 np.int32)
-                        res.delta_cc = (idx, res.cc_labels[idx])
+                        res.delta_cc = _frozen_delta(
+                            idx, res.cc_labels[idx])
                 if "cover" in outs:
                     if "_odd_rows" in outs:  # native delta path: the
                         # odd matrix was already computed for the mask
@@ -681,13 +717,14 @@ class StreamingAnalyticsDriver:
                     else:
                         plus = outs["cover"][i][:vb]
                         minus = outs["cover"][i][vb:2 * vb]
-                        res.bipartite_odd = (plus == minus)[:nv]
+                        res.bipartite_odd = _snapshot_view(
+                            (plus == minus)[:nv])
                     if "cover_chg" in outs:
                         idx = np.nonzero(
                             outs["cover_chg"][i][:nv])[0].astype(
                                 np.int32)
-                        res.delta_bipartite = (
-                            idx, res.bipartite_odd[idx])
+                        res.delta_bipartite = _frozen_delta(
+                            idx, np.asarray(res.bipartite_odd)[idx])
                 if "triangles" in self.analytics:
                     # _batched_triangles (always active around this
                     # path when triangles are on) flushes these in one
@@ -756,6 +793,22 @@ class StreamingAnalyticsDriver:
                 f_outs = {k: np.asarray(v) for k, v in f_outs.items()}
             _finalize_chunk(f_at, f_chunk, f_outs)
 
+        # prep stage of the device-scan branch: the [wb, eb] stack
+        # build for chunk i+1 runs on the ingress prep pool while
+        # chunk i executes on device (single lookahead — the scan
+        # carry forces dispatches sequential, so only prep pipelines).
+        # The W-bucket is chosen on the MAIN thread at submit time
+        # (item = (chunk, wb)): _scan_wb reads/mutates the jit cache,
+        # which a pool worker must never touch concurrently with
+        # _scan_fn_at's insertions.
+        def _build_stack(item):
+            chunk, wb = item
+            s_w, d_w, valid = seg_ops.stack_window_rows(
+                [(s, d) for _w, s, d, _n in chunk], wb, self.eb, vb)
+            return wb, s_w, d_w, valid
+
+        prefetched = None  # (at, future) for the next chunk's stacks
+
         for at in range(0, num_w, scan_chunk):
             chunk = interned[at:at + scan_chunk]
             outs = {}
@@ -795,14 +848,25 @@ class StreamingAnalyticsDriver:
                             [podd, odd[:-1]])
                         outs["_odd_rows"] = odd  # reused at extraction
             elif run_scan:
-                fn, wb = self._scan_fn(len(chunk))
-                s_w = np.full((wb, self.eb), vb, np.int32)
-                d_w = np.full((wb, self.eb), vb, np.int32)
-                valid = np.zeros((wb, self.eb), bool)
-                for i, (_ws, s, d, _nv) in enumerate(chunk):
-                    s_w[i, :len(s)] = s
-                    d_w[i, :len(d)] = d
-                    valid[i, :len(s)] = True
+                if prefetched is not None and prefetched[0] == at:
+                    wb, s_w, d_w, valid = prefetched[1].result()
+                else:
+                    wb, s_w, d_w, valid = _build_stack(
+                        (chunk, self._scan_wb(len(chunk))))
+                prefetched = None
+                fn = self._scan_fn_at(wb)
+                # submit the NEXT chunk's prep only after this chunk's
+                # program is in the cache, so the ragged final chunk's
+                # bigger-bucket reuse sees it (no tail compile) and
+                # the worker itself never touches the cache
+                nxt = at + scan_chunk
+                if nxt < num_w:
+                    nxt_chunk = interned[nxt:nxt + scan_chunk]
+                    fut = ingress_pipeline.submit_prep(
+                        _build_stack,
+                        (nxt_chunk, self._scan_wb(len(nxt_chunk))))
+                    if fut is not None:
+                        prefetched = (nxt, fut)
                 with self._step("snapshot_scan",
                                 sum(len(s) for _w, s, _d, _n in chunk)):
                     # async dispatch: returns device arrays without
@@ -943,7 +1007,7 @@ class StreamingAnalyticsDriver:
         n = min(len(prev), len(new))
         full[:n] = prev[:n]
         idx = np.nonzero(new != full)[0].astype(np.int32)
-        return idx, new[idx]
+        return _frozen_delta(idx, new[idx])
 
     def _attach_host_deltas(self, res: WindowResult,
                             prev: dict) -> None:
@@ -1020,8 +1084,9 @@ class StreamingAnalyticsDriver:
         sharded = self._engine is not None
         if name == "degrees":
             if sharded:
-                res.degrees = np.array(self._engine.degrees(s, d)[:nv])
-                self._check_degree_width(res.degrees)
+                snap = np.array(self._engine.degrees(s, d)[:nv])
+                self._check_degree_width(snap)
+                res.degrees = _snapshot_view(snap)
             else:
                 import jax.numpy as jnp
 
@@ -1055,7 +1120,8 @@ class StreamingAnalyticsDriver:
                 res.degrees = _snapshot_view(snap.copy())
         elif name == "cc":
             if sharded:
-                res.cc_labels = np.array(self._engine.cc_labels(s, d)[:nv])
+                res.cc_labels = _snapshot_view(
+                    np.array(self._engine.cc_labels(s, d)[:nv]))
             else:
                 if len(self._cc) < nv:
                     self._cc = np.concatenate([
@@ -1068,7 +1134,7 @@ class StreamingAnalyticsDriver:
         elif name == "bipartite":
             if sharded:
                 _, _, odd = self._engine.bipartite(s, d)
-                res.bipartite_odd = np.array(odd[:nv])
+                res.bipartite_odd = _snapshot_view(np.array(odd[:nv]))
             else:
                 # cover layout is VERTEX-BUCKET based ((+) = v,
                 # (−) = vb + v), so the kernel shape depends only on
@@ -1083,7 +1149,7 @@ class StreamingAnalyticsDriver:
                     edge_bucket=2 * self.eb)
                 _, _, odd = unionfind.decode_double_cover(self._bip,
                                                           self.vb)
-                res.bipartite_odd = odd[:nv]
+                res.bipartite_odd = _snapshot_view(odd[:nv])
         elif name == "triangles":
             if self._tri_pending is not None:
                 # batched mode (run_arrays): defer — all of the call's

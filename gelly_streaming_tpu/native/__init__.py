@@ -260,8 +260,16 @@ def windowed_reduce(src: np.ndarray, dst: np.ndarray, val: np.ndarray,
     out_i32 = (val.dtype == np.int32 and (ids_i32 or ids_i64)
                and getattr(_lib, "gs_windowed_reduce_i64i32o", None)
                is not None)
+    per_cell = eb * (2 if direction == "all" else 1)
+    # the COUNTS slab shares the output dtype, and one cell can
+    # receive up to per_cell (= 2·eb for direction 'all')
+    # contributions regardless of the reduce op — so the int32 form
+    # needs 2*eb <= INT32_MAX for min/max and for the all-zero-sum
+    # case too, where the old value-only gate (0 × per_cell) passed
+    # vacuously while the counts could still wrap
+    if out_i32:
+        out_i32 = per_cell <= np.iinfo(np.int32).max
     if out_i32 and name == "sum" and n:
-        per_cell = eb * (2 if direction == "all" else 1)
         # exact max|val| via two scans in Python ints (np.abs wraps on
         # INT32_MIN and would pass the gate with a negative bound)
         maxabs = max(int(val.max()), -int(val.min()))

@@ -40,19 +40,54 @@ def supports(vb: int) -> bool:
     return vb <= MAX_U16_VB
 
 
-def build_stream_fn(window_fn, vb: int, eb: int):
-    """The compact twin of TriangleWindowKernel._build_stream: widen
-    uint16 ids, rebuild the suffix mask from per-window counts, then
-    lax.map the SAME per-window program. Returns an un-jitted callable
-    (callers jit/AOT-compile it alongside the standard form)."""
-    import jax
+def validate_ids(src: np.ndarray, dst: np.ndarray, bound: int,
+                 what: str = "compact ingress") -> None:
+    """Raise ValueError for any id the uint16 cast would WRAP
+    (negatives, and ids ≥ min(bound, 65536)) — the one wrap-safety
+    check every compact consumer runs on the MAIN thread before its
+    pipeline (so callers see the same ValueError the other tiers
+    raise, never a pooled-prep RuntimeError). `bound` is the caller's
+    own id range (e.g. the reduce engine's vbp); the uint16 ceiling is
+    applied on top, so a vb=65536 consumer whose nominal range reaches
+    65536 still rejects the one unrepresentable id loudly."""
+    if len(src) == 0 and len(dst) == 0:
+        return
+    top = int(max(src.max(), dst.max()))
+    bot = int(min(src.min(), dst.min()))
+    limit = min(bound, MAX_U16_VB)
+    if bot < 0 or top >= limit:
+        raise ValueError(
+            "vertex id %d outside [0, %d) in %s input"
+            % (bot if bot < 0 else top, limit, what))
+
+
+def widen_stack(src16, dst16, nvalid, eb: int, sentinel: int):
+    """The ONE device-side decode of the compact wire format
+    (jax-traceable): rebuild the per-window suffix mask from the valid
+    counts and widen uint16 ids to int32 with `sentinel` in the padded
+    slots. Returns (s, d, valid), each [wb, eb]. Every compact
+    consumer (the triangle stream program, the fused scan, the
+    windowed-reduce stack program) decodes through here, so a format
+    change cannot silently diverge between them."""
     import jax.numpy as jnp
 
+    pos = jnp.arange(eb, dtype=jnp.int32)[None, :]
+    valid = pos < nvalid[:, None]
+    s = jnp.where(valid, src16.astype(jnp.int32), sentinel)
+    d = jnp.where(valid, dst16.astype(jnp.int32), sentinel)
+    return s, d, valid
+
+
+def build_stream_fn(window_fn, vb: int, eb: int):
+    """The compact twin of TriangleWindowKernel._build_stream: widen
+    uint16 ids, rebuild the suffix mask from per-window counts
+    (widen_stack), then lax.map the SAME per-window program. Returns
+    an un-jitted callable (callers jit/AOT-compile it alongside the
+    standard form)."""
+    import jax
+
     def run_stream(src16, dst16, nvalid):  # [wb, eb] u16, [wb] i32
-        pos = jnp.arange(eb, dtype=jnp.int32)[None, :]
-        valid = pos < nvalid[:, None]
-        s = jnp.where(valid, src16.astype(jnp.int32), vb)
-        d = jnp.where(valid, dst16.astype(jnp.int32), vb)
+        s, d, valid = widen_stack(src16, dst16, nvalid, eb, vb)
         return jax.lax.map(lambda t: window_fn(*t), (s, d, valid))
 
     return run_stream

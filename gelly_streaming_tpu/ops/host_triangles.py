@@ -110,14 +110,25 @@ def window_count(src: np.ndarray, dst: np.ndarray) -> int:
 def count_stream(src: np.ndarray, dst: np.ndarray, eb: int) -> list:
     """Exact counts of every tumbling eb-sized window of the stream —
     the host form of TriangleWindowKernel.count_stream (same window
-    boundaries, same counts)."""
+    boundaries, same counts). Windows are independent, so they count
+    in parallel across the ingress prep pool
+    (ops/ingress_pipeline.map_ordered — numpy's argsort/searchsorted
+    cores drop the GIL); results return in window order, identical at
+    every pool size."""
+    from . import ingress_pipeline
+
     src = np.asarray(src)
     dst = np.asarray(dst)
-    return [window_count(src[at:at + eb], dst[at:at + eb])
-            for at in range(0, len(src), eb)]
+    return ingress_pipeline.map_ordered(
+        lambda at: window_count(src[at:at + eb], dst[at:at + eb]),
+        range(0, len(src), eb))
 
 
 def count_windows(windows) -> list:
     """Exact counts of explicit (src, dst) window batches — the host
-    form of TriangleWindowKernel.count_windows."""
-    return [window_count(s, d) for s, d in windows]
+    form of TriangleWindowKernel.count_windows (same per-window pool
+    parallelism as count_stream)."""
+    from . import ingress_pipeline
+
+    return ingress_pipeline.map_ordered(
+        lambda w: window_count(w[0], w[1]), windows)

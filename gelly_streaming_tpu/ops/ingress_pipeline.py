@@ -1,0 +1,352 @@
+"""Three-stage host-ingress pipeline: parallel window prep ‖ h2d
+transfer ‖ device compute.
+
+PERF.md's verified chip ladder pins the end-to-end stream rate at
+~500-770K edges/s across every scale — flat while the baseline falls
+10× — i.e. the wall is serialized single-core host prep plus
+transfer/dispatch, not the K×K device compare. The reference delegates
+exactly this ingest/shuffle layer to Flink's network stack
+(SimpleEdgeStream.java:60-90); this module is its TPU-native
+replacement, generalizing the depth-2 producer thread that used to
+live inline in TriangleWindowKernel._run_stack_loop into ONE reusable
+pipeline every streaming kernel routes through (triangles,
+windowed_reduce, the fused scan engines, and the sharded kernels —
+which keep their own table contract but share this loop).
+
+Stages, per chunk of windows:
+
+  1. PREP     — build the padded host stacks (seg_ops.window_stack /
+                compact_ingress slicing / cell-id packing). Runs on a
+                process-wide worker POOL (`prep_pool`), so several
+                chunks prep concurrently — numpy copies and the native
+                parser drop the GIL, so the parallelism is real. Prep
+                results are consumed strictly in chunk order; worker
+                scheduling can never reorder (or change) results, so
+                counts are identical at every pool size.
+  2. H2D      — convert/enqueue the host stacks to device arrays on
+                the SAME worker, immediately after that chunk's prep
+                (timed as its own stage). Through a tunneled chip a
+                device_put is effectively synchronous network time,
+                so running it on the worker is what lets chunk i+1's
+                transfer overlap both device execution and the main
+                thread's blocking d2h wait on chunk i-1 — the overlap
+                the round-5 producer thread provided.
+  3. DISPATCH — enqueue the chunk's device program (async, main
+                thread, chunk order) and, one chunk later,
+                MATERIALIZE the previous chunk's outputs (d2h +
+                overflow recounts), so the d2h round trip of chunk i
+                hides behind chunk i+1's execution — the same depth-2
+                discipline as before, now with a parallel front end.
+
+Per-stage wall time accumulates in a `StageTimers` (prep/h2d/compute
+ms per chunk) that tools/profile_kernels.py commits to PERF.json, so
+the next tunnel window can decompose the chip-side wall without new
+instrumentation.
+
+Env knobs:
+  GS_STREAM_PREFETCH=0  — force the fully synchronous single-threaded
+                          form (no pool, prep inline; dispatch keeps
+                          its depth-2 overlap). Same counts.
+  GS_PIPELINE_WORKERS=N — prep pool size (default min(4, cpus-1);
+                          0 behaves like GS_STREAM_PREFETCH=0 for the
+                          pool while keeping the API).
+  GS_PIPELINE_INFLIGHT=N — max prepped+TRANSFERRED chunks in flight
+                          ahead of dispatch in run_pipeline (default
+                          3): the host+HBM footprint bound the old
+                          depth-2 producer queue provided, kept
+                          independent of the pool width so capping
+                          device memory never requires shrinking prep
+                          parallelism for the host-tier map_ordered
+                          users.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Callable, Iterable, List, Optional
+
+_MAX_DEFAULT_WORKERS = 4
+
+
+class StageTimers:
+    """Per-stage wall-time accumulators of one pipelined run (or a
+    kernel's lifetime): milliseconds spent in prep (summed across
+    workers — CPU time, not critical-path time, when prep runs
+    parallel), h2d conversion/enqueue, and compute (the blocking
+    materialize wait: device execute + d2h as observed by the host).
+    `snapshot()` renders the per-chunk means PERF.json commits."""
+
+    __slots__ = ("chunks", "prep_ms", "h2d_ms", "compute_ms", "_lock")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        self.chunks = 0
+        self.prep_ms = 0.0
+        self.h2d_ms = 0.0
+        self.compute_ms = 0.0
+
+    def add(self, stage: str, seconds: float) -> None:
+        with self._lock:  # prep accumulates from several workers
+            setattr(self, stage + "_ms",
+                    getattr(self, stage + "_ms") + seconds * 1e3)
+
+    def snapshot(self) -> dict:
+        n = max(self.chunks, 1)
+        return {
+            "chunks": self.chunks,
+            "prep_ms_per_chunk": round(self.prep_ms / n, 3),
+            "h2d_ms_per_chunk": round(self.h2d_ms / n, 3),
+            "compute_ms_per_chunk": round(self.compute_ms / n, 3),
+        }
+
+
+class PrepError(RuntimeError):
+    """A prep-stage worker failed. The message carries the worker's
+    FORMATTED traceback (the raw re-raise used to surface only the
+    consumer-side frames, losing where in make_chunk the producer
+    actually died); the original exception rides as __cause__."""
+
+
+_POOL = None
+_POOL_WORKERS = None
+_POOL_LOCK = threading.Lock()
+_FORCE_SYNC = 0  # nesting depth of forced_sync() contexts
+
+
+def worker_count() -> int:
+    """Prep pool width: GS_PIPELINE_WORKERS, defaulting to
+    min(4, cpus-1) — one core stays with the main thread's
+    h2d/dispatch stage."""
+    env = os.environ.get("GS_PIPELINE_WORKERS")
+    if env is not None:
+        try:
+            return max(0, int(env))
+        except ValueError:
+            pass
+    return max(1, min(_MAX_DEFAULT_WORKERS, (os.cpu_count() or 2) - 1))
+
+
+def inflight_limit() -> int:
+    """Max prepped+transferred chunks run_pipeline keeps in flight
+    ahead of dispatch (GS_PIPELINE_INFLIGHT, default 3) — the bounded-
+    footprint contract of the old depth-2 queue, decoupled from the
+    pool width."""
+    env = os.environ.get("GS_PIPELINE_INFLIGHT")
+    if env is not None:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return 3
+
+
+def pipeline_enabled() -> bool:
+    """False when the caller (or env) pinned the synchronous form."""
+    if _FORCE_SYNC:
+        return False
+    if os.environ.get("GS_STREAM_PREFETCH", "1") == "0":
+        return False
+    return worker_count() > 0
+
+
+class forced_sync:
+    """Context manager pinning the synchronous single-threaded form —
+    the A/B lever bench.py and the profiler use to measure the
+    pipeline against its own sync baseline without env juggling.
+    Process-global (and lock-guarded, so nested/concurrent contexts
+    can't corrupt the depth): while any context is active, EVERY
+    pipelined call in the process runs sync — measurement harnesses
+    must not run unrelated pipelined work concurrently."""
+
+    def __enter__(self):
+        global _FORCE_SYNC
+        with _POOL_LOCK:
+            _FORCE_SYNC += 1
+        return self
+
+    def __exit__(self, *exc):
+        global _FORCE_SYNC
+        with _POOL_LOCK:
+            _FORCE_SYNC -= 1
+        return False
+
+
+def prep_pool():
+    """The process-wide prep ThreadPoolExecutor (lazily built, rebuilt
+    when GS_PIPELINE_WORKERS changes); None when pipelining is off."""
+    global _POOL, _POOL_WORKERS
+    if not pipeline_enabled():
+        return None
+    w = worker_count()
+    with _POOL_LOCK:
+        if _POOL is None or _POOL_WORKERS != w:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # a superseded pool is ABANDONED, never shut down: a
+            # concurrent run still holding it must be able to finish
+            # submitting (ThreadPoolExecutor's workers exit on their
+            # own once the dropped executor is garbage collected)
+            _POOL = ThreadPoolExecutor(
+                max_workers=w, thread_name_prefix="gs-ingress-prep")
+            _POOL_WORKERS = w
+        return _POOL
+
+
+def reset_pool() -> None:
+    """Test hook: drop the memoized pool (e.g. after changing
+    GS_PIPELINE_WORKERS mid-process). The old pool is abandoned, not
+    shut down — see prep_pool."""
+    global _POOL, _POOL_WORKERS
+    with _POOL_LOCK:
+        _POOL = None
+        _POOL_WORKERS = None
+
+
+def _timed_prep(prep: Callable, item, timers: Optional[StageTimers]):
+    """Worker-side prep wrapper: times the call and converts a failure
+    into a PrepError carrying the formatted worker traceback."""
+    t0 = time.perf_counter()
+    try:
+        out = prep(item)
+    except Exception as e:
+        # Exception only: KeyboardInterrupt/SystemExit must abort the
+        # run unwrapped (a broad caller-side `except RuntimeError`
+        # fallback must never eat an interrupt as a prep failure);
+        # pool futures re-raise those at .result() regardless
+        raise PrepError(
+            "ingress prep stage failed for chunk %r:\n%s"
+            % (item, traceback.format_exc())) from e
+    if timers is not None:
+        timers.add("prep", time.perf_counter() - t0)
+    return out
+
+
+def _prep_then_h2d(prep: Callable, h2d: Callable, item,
+                   timers: Optional[StageTimers]):
+    """One worker task = prep + h2d of one chunk, each stage timed
+    separately; h2d failures carry the worker traceback too."""
+    payload = _timed_prep(prep, item, timers)
+    t0 = time.perf_counter()
+    try:
+        dev = h2d(payload)
+    except Exception as e:  # see _timed_prep: interrupts pass through
+        raise PrepError(
+            "ingress h2d stage failed for chunk %r:\n%s"
+            % (item, traceback.format_exc())) from e
+    if timers is not None:
+        timers.add("h2d", time.perf_counter() - t0)
+    return dev
+
+
+def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
+                 dispatch: Callable, finalize: Callable,
+                 timers: Optional[StageTimers] = None) -> None:
+    """Run `items` (ordered chunk descriptors) through the three
+    stages. Contracts:
+
+      prep(item)     -> host payload (pure; runs on the pool, any
+                        worker, but results are consumed in item
+                        order — parallelism never reorders effects)
+      h2d(payload)   -> device payload (runs on the SAME worker right
+                        after that chunk's prep, so a tunneled chip's
+                        synchronous transfer overlaps device execute
+                        and the previous chunk's d2h wait; must be
+                        thread-safe — jnp.asarray/device_put are)
+      dispatch(dev)  -> raw outputs (main thread, item order; must be
+                        ASYNC — do not block on device results here)
+      finalize(raw)  -> None (materializes d2h + any recount; called
+                        one item BEHIND dispatch so the round trip of
+                        chunk i hides behind chunk i+1, then once more
+                        at the end)
+
+    A prep/h2d failure surfaces in the caller as PrepError
+    (RuntimeError) carrying the worker traceback; pending futures are
+    cancelled. With pipelining disabled (`forced_sync`,
+    GS_STREAM_PREFETCH=0, or zero workers) both stages run inline —
+    identical results either way.
+    """
+    items = list(items)
+    pool = prep_pool() if len(items) > 1 else None
+    pending_raw = None
+
+    def _finalize(raw):
+        t0 = time.perf_counter()
+        finalize(raw)
+        if timers is not None:
+            timers.add("compute", time.perf_counter() - t0)
+            timers.chunks += 1
+
+    def _consume(dev):
+        nonlocal pending_raw
+        raw = dispatch(dev)
+        if pending_raw is not None:
+            _finalize(pending_raw)
+        pending_raw = raw
+
+    if pool is None:
+        for item in items:
+            _consume(_prep_then_h2d(prep, h2d, item, timers))
+    else:
+        from collections import deque
+
+        # bounded look-ahead caps host memory AND in-flight device
+        # buffers at inflight_limit() prepped+transferred chunks
+        # (default 3) — the footprint bound of the old depth-2 queue,
+        # independent of the pool width
+        lookahead = min(len(items), worker_count() + 1,
+                        inflight_limit())
+        futures = deque(
+            pool.submit(_prep_then_h2d, prep, h2d, it, timers)
+            for it in items[:lookahead])
+        nxt = lookahead
+        try:
+            while futures:
+                dev = futures.popleft().result()
+                if nxt < len(items):
+                    futures.append(pool.submit(
+                        _prep_then_h2d, prep, h2d, items[nxt], timers))
+                    nxt += 1
+                _consume(dev)
+        finally:
+            for f in futures:
+                f.cancel()
+    if pending_raw is not None:
+        _finalize(pending_raw)
+
+
+def submit_prep(fn: Callable, item, timers: Optional[StageTimers] = None):
+    """Submit ONE prep task to the pool, or None when pipelining is
+    disabled (caller then preps inline) — the single-lookahead form
+    for consumers whose dispatches carry sequential state the full
+    run_pipeline loop doesn't model (the driver's snapshot scan). The
+    future's result() raises PrepError with the worker traceback on
+    failure, same as run_pipeline."""
+    pool = prep_pool()
+    if pool is None:
+        return None
+    return pool.submit(_timed_prep, fn, item, timers)
+
+
+def map_ordered(fn: Callable, items: Iterable) -> List:
+    """Ordered parallel map over the prep pool — the host-tier form of
+    the prep stage (per-window numpy/native counting, per-window
+    first-occurrence uniques for interning). Results are returned in
+    item order regardless of worker scheduling, and the sequential
+    form runs when pipelining is disabled, so outputs are identical at
+    every pool size (the worker-pool determinism contract)."""
+    items = list(items)
+    pool = prep_pool() if len(items) > 1 else None
+    if pool is None:
+        return [_timed_prep(fn, it, None) for it in items]
+    futures = [pool.submit(_timed_prep, fn, it, None) for it in items]
+    try:
+        return [f.result() for f in futures]
+    finally:
+        for f in futures:
+            f.cancel()

@@ -38,6 +38,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import ingress_pipeline
 from . import segment as seg_ops
 from . import triangles as tri_ops
 from . import unionfind
@@ -91,9 +92,18 @@ class SummaryEngineBase:
     (exact triangle recount of one overflowing window)."""
 
     MAX_WINDOWS = 64
+    # stream-chunk wire format; StreamSummaryEngine resolves it from
+    # committed evidence (tri_ops.resolve_ingress), the sharded engine
+    # keeps the standard format (its chunks are mesh-sharded)
+    ingress = "standard"
 
     def reset(self) -> None:
         self._closed_partial = False
+        if not hasattr(self, "stage_timers"):
+            # per-stage pipeline counters (ops/ingress_pipeline);
+            # survive reset() so a timed run's snapshot is cumulative
+            # until explicitly .reset()
+            self.stage_timers = ingress_pipeline.StageTimers()
         self._carry = (
             jnp.zeros(self.vb + 1, jnp.int32),
             jnp.arange(self.vb + 1, dtype=jnp.int32),
@@ -106,11 +116,24 @@ class SummaryEngineBase:
         odd = cover[: self.vb] == cover[self.vb + 1: 2 * self.vb + 1]
         return deg[: self.vb], labels[: self.vb], odd
 
+    def _h2d(self, args):
+        """Transfer one chunk's prepped host stacks to device arrays
+        (the pipeline's timed h2d stage; the sharded engine overrides
+        with its mesh-sharded device_put)."""
+        return tuple(jnp.asarray(a) for a in args)
+
     def _dispatch_async(self, s, d, valid):
         """Enqueue one chunk (updating the device-resident carry) and
         return the raw per-window outputs WITHOUT materializing them —
         process()'s depth-2 pipeline defers the d2h to _materialize so
         it overlaps the next chunk's execution."""
+        raise NotImplementedError
+
+    def _dispatch_async_compact(self, s16, d16, nvalid):
+        """Compact-wire-format twin of _dispatch_async (uint16 stacks +
+        per-window valid counts; widening fused into the scan
+        program). Only engines whose `ingress` resolves compact need
+        it."""
         raise NotImplementedError
 
     def _materialize(self, raw):
@@ -145,16 +168,55 @@ class SummaryEngineBase:
                 "(length not a multiple of edge_bucket); reset() before "
                 "feeding more of the stream")
         self._closed_partial = n % self.eb != 0
-        num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
-                                                  sentinel=self.vb)
-        out = []
-        # depth-2 pipeline: the scan carry stays device-resident, so
-        # chunk i+1 dispatches before chunk i's d2h + extraction —
-        # host work hides behind device execution (same discipline as
-        # the driver's _run_batched and the triangle _run_stack_loop)
-        pending = None  # (at, real, raw device outputs)
+        compact = self.ingress == "compact"
+        if compact:
+            from . import compact_ingress
 
-        def finalize(f_at, f_real, raw):
+            # a wrapped id would corrupt ANOTHER vertex's carried
+            # state; the shared main-thread check raises the same
+            # ValueError every tier uses
+            compact_ingress.validate_ids(src, dst, self.vb + 1,
+                                         "fused summary scan")
+            num_w, s16, d16, nv = compact_ingress.window_stack(
+                src, dst, self.eb)
+        else:
+            num_w, s, d, valid = seg_ops.window_stack(
+                src, dst, self.eb, sentinel=self.vb)
+        out = []
+
+        # the shared three-stage ingress pipeline
+        # (ops/ingress_pipeline): chunk prep runs on the worker pool,
+        # dispatches stay in chunk order on this thread (the scan
+        # carry is sequential), and each chunk's d2h + extraction
+        # materializes one chunk behind its dispatch — host work hides
+        # behind device execution (same discipline as the driver's
+        # _run_batched and the triangle _run_stack_loop)
+        def prep(at):
+            hi = min(at + self.MAX_WINDOWS, num_w)
+            # ragged tails pad the window axis to a power-of-two bucket
+            # (all-invalid rows fold as no-ops against the carry), so
+            # varying stream lengths reuse O(log MAX_WINDOWS) programs
+            if compact:
+                sc, dc, nvc, real = compact_ingress.pad_chunk(
+                    s16, d16, nv, at, hi, self.MAX_WINDOWS, self.eb)
+                return at, real, (sc, dc, nvc)
+            sc, dc, vc, real = seg_ops.pad_window_chunk(
+                s, d, valid, at, hi, self.MAX_WINDOWS, self.eb,
+                self.vb)
+            return at, real, (sc, dc, vc)
+
+        def h2d(payload):
+            at, real, args = payload
+            return at, real, self._h2d(args)
+
+        def dispatch(dev_payload):
+            at, real, dev = dev_payload
+            raw = (self._dispatch_async_compact(*dev) if compact
+                   else self._dispatch_async(*dev))
+            return at, real, raw
+
+        def finalize(item):
+            f_at, f_real, raw = item
             mdeg, ncomp, odd, tri, b_ovf, k_ovf = (
                 x[:f_real] for x in self._materialize(raw))
             for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
@@ -170,19 +232,9 @@ class SummaryEngineBase:
                     "triangles": int(tri[w]),
                 })
 
-        for at in range(0, num_w, self.MAX_WINDOWS):
-            hi = min(at + self.MAX_WINDOWS, num_w)
-            # ragged tails pad the window axis to a power-of-two bucket
-            # (all-invalid rows fold as no-ops against the carry), so
-            # varying stream lengths reuse O(log MAX_WINDOWS) programs
-            sc, dc, vc, real = seg_ops.pad_window_chunk(
-                s, d, valid, at, hi, self.MAX_WINDOWS, self.eb, self.vb)
-            raw = self._dispatch_async(sc, dc, vc)
-            if pending is not None:
-                finalize(*pending)
-            pending = (at, real, raw)
-        if pending is not None:
-            finalize(*pending)
+        ingress_pipeline.run_pipeline(
+            range(0, num_w, self.MAX_WINDOWS),
+            prep, h2d, dispatch, finalize, timers=self.stage_timers)
         return out
 
 
@@ -193,7 +245,7 @@ class StreamSummaryEngine(SummaryEngineBase):
     kernel."""
 
     def __init__(self, edge_bucket: int, vertex_bucket: int,
-                 k_bucket: int = 0):
+                 k_bucket: int = 0, ingress: str = None):
         self.eb = seg_ops.bucket_size(edge_bucket)
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.kb = seg_ops.bucket_size(
@@ -205,6 +257,17 @@ class StreamSummaryEngine(SummaryEngineBase):
         self.MAX_WINDOWS = min(type(self).MAX_WINDOWS,
                                tri_ops.capped_chunk(self.eb,
                                                     "fused_scan"))
+        # stream-chunk wire format: same committed-evidence selection
+        # (and explicit-pin/vb-gate semantics) as TriangleWindowKernel
+        if ingress == "compact":
+            from . import compact_ingress
+
+            if not compact_ingress.supports(self.vb):
+                raise ValueError(
+                    "compact ingress is lossy for vertex_bucket %d "
+                    "(ids must fit uint16)" % self.vb)
+        self.ingress = (ingress if ingress
+                        else tri_ops.resolve_ingress(self.vb))
         body = _build_scan(self.eb, self.vb, self.kb)
 
         @jax.jit
@@ -212,6 +275,23 @@ class StreamSummaryEngine(SummaryEngineBase):
             return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
 
         self._run = run
+        if self.ingress == "compact":
+            eb_, vb_ = self.eb, self.vb
+
+            # the compact twin: the shared device-side decode
+            # (compact_ingress.widen_stack — widen uint16 ids +
+            # rebuild the suffix mask from per-window counts) fused
+            # into the same scan program, applied to the whole
+            # [W, eb] stack before the scan consumes it
+            from . import compact_ingress as _ci
+
+            @jax.jit
+            def run_c(carry, s16, d16, nvalid):
+                s_w, d_w, valid_w = _ci.widen_stack(
+                    s16, d16, nvalid, eb_, vb_)
+                return jax.lax.scan(body, carry, (s_w, d_w, valid_w))
+
+            self._run_c = run_c
         self._tri_fallback = tri_ops.TriangleWindowKernel(
             edge_bucket=self.eb, vertex_bucket=self.vb,
             k_bucket=4 * self.kb)
@@ -221,6 +301,12 @@ class StreamSummaryEngine(SummaryEngineBase):
         self._carry, outs = self._run(
             self._carry, jnp.asarray(s), jnp.asarray(d),
             jnp.asarray(valid))
+        return outs
+
+    def _dispatch_async_compact(self, s16, d16, nvalid):
+        self._carry, outs = self._run_c(
+            self._carry, jnp.asarray(s16), jnp.asarray(d16),
+            jnp.asarray(nvalid))
         return outs
 
     def _materialize(self, raw):

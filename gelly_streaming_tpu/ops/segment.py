@@ -266,6 +266,23 @@ def stack_window_list(windows, eb: int, sentinel: int):
     return s, d, valid
 
 
+def stack_window_rows(pairs, wb: int, eb: int, sentinel: int):
+    """Pack a chunk's dense (src, dst) window arrays into [wb, eb]
+    stacks + validity mask (rows past len(pairs) stay all-sentinel) —
+    the driver's snapshot-scan chunk prep, factored here so the
+    ingress pipeline can run it on the prep worker pool
+    (ops/ingress_pipeline) while the previous chunk executes on
+    device."""
+    s_w = np.full((wb, eb), sentinel, np.int32)
+    d_w = np.full((wb, eb), sentinel, np.int32)
+    valid = np.zeros((wb, eb), bool)
+    for i, (s, d) in enumerate(pairs):
+        s_w[i, :len(s)] = s
+        d_w[i, :len(d)] = d
+        valid[i, :len(s)] = True
+    return s_w, d_w, valid
+
+
 def pad_window_chunk(s, d, valid, at: int, hi: int, max_w: int,
                      eb: int, sentinel: int):
     """Slice [at:hi] of a [W, eb] stack and pad the window axis to a

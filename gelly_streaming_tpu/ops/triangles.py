@@ -33,6 +33,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from . import ingress_pipeline
 from . import segment as seg_ops
 
 DENSE_LIMIT = 2048
@@ -446,6 +447,44 @@ def _pick_host_tier(rows) -> str:
     return impl
 
 
+def _native_count_stream_parallel(src: np.ndarray, dst: np.ndarray,
+                                  eb: int):
+    """The native (C++) stream tier across the ingress prep pool:
+    windows are independent, so the stream splits into window-ALIGNED
+    slices, one gs_triangle_count_stream call per slice, run
+    concurrently (the ctypes call drops the GIL for the C++ pass).
+    Slice results concatenate in order — counts are identical to the
+    single-call form at every pool size. None when the library (or
+    symbol) is unavailable, same as the underlying binding."""
+    from .. import native as native_mod
+
+    if not native_mod.triangles_available():
+        return None
+    if not ingress_pipeline.pipeline_enabled():
+        # the TRUE sync form is the single whole-stream C++ call (no
+        # slice copies, one ctypes crossing) — forced_sync /
+        # GS_STREAM_PREFETCH=0 must measure exactly the pre-pipeline
+        # shape, or the bench A/B inflates pipeline_speedup
+        counts = native_mod.triangle_count_stream(src, dst, eb)
+        return None if counts is None else [int(x) for x in counts]
+    num_w = -(-len(src) // eb)
+    # ~4 slices per worker amortizes call overhead while keeping the
+    # pool busy through windows of uneven triangle cost
+    groups = max(1, min(num_w,
+                        4 * max(1, ingress_pipeline.worker_count())))
+    per = -(-num_w // groups)
+
+    def one(at):
+        return native_mod.triangle_count_stream(
+            src[at * eb:(at + per) * eb], dst[at * eb:(at + per) * eb],
+            eb)
+
+    parts = ingress_pipeline.map_ordered(one, range(0, num_w, per))
+    if any(p is None for p in parts):
+        return None
+    return [int(x) for p in parts for x in p]
+
+
 def _resolve_stream_impl(eb: int = None) -> str:
     """Streaming-counter tier: the device (XLA) kernel by default; a
     HOST tier only on committed backend-matched measurements
@@ -777,6 +816,10 @@ class TriangleWindowKernel:
                     "compact ingress is lossy for vertex_bucket %d "
                     "(ids must fit uint16)" % self.vb)
         self.ingress = ingress if ingress else resolve_ingress(self.vb)
+        # per-stage wall-time counters of every pipelined stream run
+        # through this kernel (ops/ingress_pipeline.StageTimers);
+        # tools/profile_kernels.py commits their snapshot to PERF.json
+        self.stage_timers = ingress_pipeline.StageTimers()
         self._fns = {self.kb: self._build(self.kb)}
         self._stream_fns = {}
         self._stream_execs = {}
@@ -864,99 +907,60 @@ class TriangleWindowKernel:
 
 
     def _run_stack_loop(self, num_w: int, make_chunk, recount) -> list:
-        """The ONE pipelined chunk loop both wire formats run.
-        `make_chunk(at, hi)` returns (args_tuple, n) — the padded
-        device arguments for windows [at:hi] plus the real window
-        count (the window axis of a ragged final chunk pads to a
-        power-of-two bucket, so varying stream lengths reuse
-        O(log MAX_STREAM_WINDOWS) compiled programs); `recount(w)`
-        exactly recounts window w when its hubs overflow K.
-
-        Two overlap mechanisms stack here (VERDICT r4 item 2 — the
+        """The ONE pipelined chunk loop both wire formats run, routed
+        through the shared three-stage ingress pipeline
+        (ops/ingress_pipeline.run_pipeline — VERDICT r4 item 2: the
         chip rate was pinned ~600K edges/s by serialized host work):
 
-        - a PRODUCER THREAD preps + enqueues the h2d of chunk i+1
-          while the main thread dispatches/awaits chunk i. Through the
-          tunneled chip a device_put is effectively synchronous
-          network time; in a worker thread (numpy copies and the PJRT
-          transfer both release the GIL) it runs concurrently with
-          device execution. Bounded queue (depth 2) caps host+HBM
-          footprint at two in-flight chunks. `GS_STREAM_PREFETCH=0`
-          forces the single-threaded form.
-        - dispatch stays PIPELINED depth 2: chunk i's [W]-scalar
-          outputs are materialized only after chunk i+1 is enqueued,
-          so the d2h round-trip of one chunk hides behind the next.
+        - PREP runs on the worker POOL: `make_chunk(at, hi)` returns
+          (args_tuple, n) — the padded host stacks for windows
+          [at:hi] plus the real window count (a ragged final chunk
+          pads its window axis to a power-of-two bucket, so varying
+          stream lengths reuse O(log MAX_STREAM_WINDOWS) programs).
+          Several chunks prep concurrently; results are consumed in
+          chunk order, so counts never depend on the pool size.
+        - H2D converts the stacks on the SAME worker right after that
+          chunk's prep (through a tunneled chip a device_put is
+          effectively synchronous network time, so the transfer
+          overlaps device execute and the previous chunk's d2h wait;
+          the stage timer decomposes it) — the h2d closure must stay
+          thread-safe, i.e. jnp.asarray of worker-local arrays only.
+        - DISPATCH stays pipelined depth 2: chunk i's [W]-scalar
+          outputs materialize only after chunk i+1 is enqueued, so
+          the d2h round trip of one chunk hides behind the next.
+          `recount(w)` exactly recounts window w when its hubs
+          overflow K.
+
+        `GS_STREAM_PREFETCH=0` (or ingress_pipeline.forced_sync)
+        forces the single-threaded inline-prep form — same counts.
         """
         counts: list = []
-        pending = None  # (at, n, c_dev, o_dev)
 
-        def materialize(at, n, c_dev, o_dev):
+        def prep(at):
+            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
+            args, n = make_chunk(at, hi)
+            return at, n, args
+
+        def h2d(payload):
+            at, n, args = payload
+            return at, n, [jnp.asarray(a) for a in args]
+
+        def dispatch(dev_payload):
+            at, n, dev = dev_payload
+            c, o = self._stream_exec(dev[0].shape[0])(*dev)
+            return at, n, c, o
+
+        def finalize(raw):
+            at, n, c_dev, o_dev = raw
             # np.array (not asarray): device outputs can be read-only
             c, o = np.array(c_dev)[:n], np.array(o_dev)[:n]
             for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
                 c[w] = recount(at + int(w))
             counts.extend(int(x) for x in c)
 
-        starts = list(range(0, num_w, self.MAX_STREAM_WINDOWS))
-
-        def prep(at):
-            hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
-            args, n = make_chunk(at, hi)
-            return at, n, [jnp.asarray(a) for a in args]
-
-        if len(starts) > 1 and os.environ.get(
-                "GS_STREAM_PREFETCH", "1") != "0":
-            import queue as _queue
-            import threading
-
-            q = _queue.Queue(maxsize=2)
-            stop = threading.Event()
-
-            def _put(item):
-                while not stop.is_set():
-                    try:
-                        q.put(item, timeout=0.25)
-                        return True
-                    except _queue.Full:
-                        continue
-                return False
-
-            def producer():
-                try:
-                    for at in starts:
-                        if not _put(prep(at)):
-                            return
-                    _put(None)
-                except BaseException as e:  # surfaces in the consumer
-                    _put(e)
-
-            t = threading.Thread(target=producer, daemon=True,
-                                 name="gs-stream-prefetch")
-            t.start()
-            try:
-                while True:
-                    item = q.get()
-                    if item is None:
-                        break
-                    if isinstance(item, BaseException):
-                        raise item
-                    at, n, dev = item
-                    c, o = self._stream_exec(dev[0].shape[0])(*dev)
-                    if pending is not None:
-                        materialize(*pending)
-                    pending = (at, n, c, o)
-            finally:
-                stop.set()
-                t.join(timeout=5)
-        else:
-            for at in starts:
-                at, n, dev = prep(at)
-                c, o = self._stream_exec(dev[0].shape[0])(*dev)
-                if pending is not None:
-                    materialize(*pending)
-                pending = (at, n, c, o)
-        if pending is not None:
-            materialize(*pending)
+        ingress_pipeline.run_pipeline(
+            range(0, num_w, self.MAX_STREAM_WINDOWS),
+            prep, h2d, dispatch, finalize, timers=self.stage_timers)
         return counts
 
     def _run_stack(self, s, d, valid, get_window) -> list:
@@ -1017,11 +1021,9 @@ class TriangleWindowKernel:
             return []
         impl = _resolve_stream_impl(self.eb)
         if impl == "native":
-            from .. import native as native_mod
-
-            counts = native_mod.triangle_count_stream(src, dst, self.eb)
+            counts = _native_count_stream_parallel(src, dst, self.eb)
             if counts is not None:
-                return [int(x) for x in counts]
+                return counts
             impl = "host"  # stale library: numpy tier stands in
         if impl == "host":
             from . import host_triangles
@@ -1062,15 +1064,18 @@ class TriangleWindowKernel:
         if impl == "native":
             from .. import native as native_mod
 
-            out = []
-            for s, d in windows:
+            def one(win):
+                s, d = win
                 c = native_mod.triangle_count_stream(
                     np.asarray(s), np.asarray(d), max(len(s), 1))
                 if c is None:
-                    out = None
-                    break
-                out.append(int(c[0]) if len(c) else 0)
-            if out is not None:
+                    return None
+                return int(c[0]) if len(c) else 0
+
+            # per-window ctypes calls across the prep pool (the C++
+            # kernel drops the GIL); window order is preserved
+            out = ingress_pipeline.map_ordered(one, windows)
+            if all(c is not None for c in out):
                 return out
             impl = "host"  # stale library: numpy tier stands in
         if impl == "host":

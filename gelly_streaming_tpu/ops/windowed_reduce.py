@@ -130,7 +130,7 @@ class WindowedEdgeReduce:
 
     def __init__(self, vertex_bucket: int, edge_bucket: int,
                  name: str = "sum", direction: str = "out",
-                 fn=None):
+                 fn=None, ingress: str = None):
         if direction not in _DIRECTIONS:
             raise ValueError(f"direction must be one of {_DIRECTIONS}")
         if fn is not None:
@@ -150,6 +150,26 @@ class WindowedEdgeReduce:
         self.name = name
         self.fn = fn
         self.direction = direction
+        # stream-chunk wire format of the monoid DEVICE tier: uint16
+        # ids + per-window valid counts with the (window, vertex) cell
+        # ids computed on device (2×u16 + vals vs host-built int64
+        # flat ids — fewer h2d bytes AND the id packing moves off the
+        # single host core). Same committed-evidence selection and
+        # vb gate as TriangleWindowKernel (ops/triangles.
+        # resolve_ingress; standard is the fallback whenever
+        # compact_ingress.supports(vb) is false).
+        if ingress == "compact":
+            from . import compact_ingress
+
+            if not compact_ingress.supports(self.vb):
+                raise ValueError(
+                    "compact ingress is lossy for vertex_bucket %d "
+                    "(ids must fit uint16)" % self.vb)
+        self.ingress = (ingress if ingress
+                        else _tri.resolve_ingress(self.vb))
+        from . import ingress_pipeline as _ip
+
+        self.stage_timers = _ip.StageTimers()
         self._fns = {}
 
     # ---- jitted stack program (monoid tier) ---------------------------
@@ -174,6 +194,55 @@ class WindowedEdgeReduce:
                 return cells, counts
 
             self._fns[wb] = fn = run
+        return fn
+
+    def _stack_fn_compact(self, wb: int):
+        """Compact twin of _stack_fn: consumes [wb, eb] uint16 id
+        stacks + [wb] valid counts + [wb, eb] values, rebuilds the
+        suffix mask and the flattened (window, vertex) cell ids ON
+        DEVICE (the widening fused into the same program), then runs
+        the identical segment kernels — same cells/counts."""
+        key = ("compact", wb)
+        fn = self._fns.get(key)
+        if fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            vbp = self.vb + 1
+            n_cells = wb * vbp
+            eb = self.eb
+            name = self.name
+            direction = self.direction
+
+            from . import compact_ingress
+
+            @jax.jit
+            def run(s16, d16, nvalid, vals):
+                # shared compact decode (sentinel 0: the trash-cell
+                # `where` below masks padded slots by `valid`)
+                s32, d32, valid = compact_ingress.widen_stack(
+                    s16, d16, nvalid, eb, 0)
+                base = (jnp.arange(wb, dtype=jnp.int32) * vbp)[:, None]
+
+                def ids_of(v32):
+                    return jnp.where(valid, base + v32,
+                                     n_cells).reshape(-1)
+
+                if direction == "out":
+                    ids, v = ids_of(s32), vals.reshape(-1)
+                elif direction == "in":
+                    ids, v = ids_of(d32), vals.reshape(-1)
+                else:
+                    ids = jnp.concatenate([ids_of(s32), ids_of(d32)])
+                    v = jnp.concatenate([vals.reshape(-1)] * 2)
+                cells = seg_ops.segment_reduce(
+                    v, ids, n_cells + 1, name)[:-1].reshape(wb, vbp)
+                counts = jax.ops.segment_sum(
+                    jnp.where(ids < n_cells, 1, 0), ids,
+                    n_cells + 1)[:-1].reshape(wb, vbp)
+                return cells, counts
+
+            self._fns[key] = fn = run
         return fn
 
     def _cell_ids(self, src, dst, win, valid, vbp, n_cells):
@@ -258,15 +327,30 @@ class WindowedEdgeReduce:
 
     def _device_process_stream(self, src, dst, val):
         """The device path, selection bypassed (the profiler measures
-        both tiers through this split)."""
+        both tiers through this split). Monoid chunks route through
+        the shared three-stage ingress pipeline
+        (ops/ingress_pipeline): cell-id/stack prep on the worker
+        pool, h2d + dispatch in chunk order, each chunk's d2h one
+        chunk behind — with the compact wire format (uint16 stacks +
+        valid counts, widening fused on device) when the kernel's
+        resolved ingress is compact. The associative-user-fn tier
+        keeps its host argsort inline (its reduce runs through the
+        host-sorted flagged scan, not the stack program)."""
         n = len(src)
         out: List[Tuple[np.ndarray, np.ndarray]] = []
         eb, vbp = self.eb, self.vb + 1
         num_w = -(-n // eb)
+        chunks = []
         at = 0
         while at < num_w:
             wb = min(self.MAX_STREAM_WINDOWS, num_w - at)
             wb = seg_ops.bucket_size(wb)   # O(log) programs over tails
+            chunks.append((at, wb))
+            at += wb
+        def standard_chunk(at, wb):
+            """Flat (cell ids, values) of windows [at, at+wb) in the
+            standard wire format — shared by the associative-fn inline
+            loop and the pipeline's prep stage."""
             lo, hi = at * eb, min((at + wb) * eb, n)
             s = seg_ops.pad_to(src[lo:hi], wb * eb)
             d = seg_ops.pad_to(dst[lo:hi], wb * eb)
@@ -274,16 +358,13 @@ class WindowedEdgeReduce:
             valid = seg_ops.pad_to(np.ones(hi - lo, bool), wb * eb,
                                    fill=False)
             win = np.arange(wb * eb) // eb
-            n_cells = wb * vbp
-            ids, rep = self._cell_ids(s, d, win, valid, vbp, n_cells)
-            vals = np.concatenate([v] * rep)
-            if self.name is not None:
-                import jax.numpy as jnp
+            ids, rep = self._cell_ids(s, d, win, valid, vbp, wb * vbp)
+            return ids, np.concatenate([v] * rep)
 
-                cells, counts = self._stack_fn(wb)(
-                    jnp.asarray(ids), jnp.asarray(vals))
-                cells, counts = np.asarray(cells), np.asarray(counts)
-            else:
+        if self.name is None:
+            for at, wb in chunks:
+                n_cells = wb * vbp
+                ids, vals = standard_chunk(at, wb)
                 order = np.argsort(ids, kind="stable")
                 res, _has = seg_ops.segmented_reduce_associative(
                     self.fn, ids[order], vals[order], n_cells)
@@ -291,10 +372,60 @@ class WindowedEdgeReduce:
                 counts = np.bincount(
                     ids[ids < n_cells],
                     minlength=n_cells).reshape(wb, vbp)
-            real_w = min(wb, num_w - at)
-            for w in range(real_w):
+                for w in range(min(wb, num_w - at)):
+                    out.append((cells[w], counts[w]))
+            return out
+
+        from . import ingress_pipeline
+
+        compact = self.ingress == "compact"
+        if compact and n:
+            from . import compact_ingress
+
+            # the shared main-thread wrap-safety check: bad ids raise
+            # the same ValueError every other tier raises (a pooled
+            # prep failure would wrap it in PrepError/RuntimeError)
+            compact_ingress.validate_ids(src, dst, vbp,
+                                         "windowed reduce")
+
+        def prep(item):
+            at, wb = item
+            lo, hi = at * eb, min((at + wb) * eb, n)
+            if compact:
+                from . import compact_ingress
+
+                _w, s16, d16, nv = compact_ingress.window_stack(
+                    src[lo:hi], dst[lo:hi], eb)
+                s16 = seg_ops.pad_to(s16, wb)
+                d16 = seg_ops.pad_to(d16, wb)
+                nv = seg_ops.pad_to(nv, wb)
+                v = seg_ops.pad_to(val[lo:hi],
+                                   wb * eb).reshape(wb, eb)
+                return at, wb, (s16, d16, nv, v)
+            return (at, wb) + (standard_chunk(at, wb),)
+
+        def h2d(payload):
+            import jax.numpy as jnp
+
+            at, wb, args = payload
+            return at, wb, tuple(jnp.asarray(a) for a in args)
+
+        def dispatch(dev_payload):
+            at, wb, dev = dev_payload
+            fn = (self._stack_fn_compact(wb) if compact
+                  else self._stack_fn(wb))
+            cells, counts = fn(*dev)
+            return at, wb, cells, counts
+
+        def finalize(raw):
+            at, wb, cells, counts = raw
+            cells, counts = np.asarray(cells), np.asarray(counts)
+            for w in range(min(wb, num_w - at)):
                 out.append((cells[w], counts[w]))
-            at += wb
+
+        ingress_pipeline.run_pipeline(chunks, prep, h2d, dispatch,
+                                      finalize,
+                                      timers=self.stage_timers)
         return out
 
     # ---- host (numpy) tier -------------------------------------------

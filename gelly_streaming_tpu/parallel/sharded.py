@@ -34,7 +34,7 @@ except ImportError:  # older jax
 
 from .mesh import (SHARD_AXIS, make_mesh, mesh_padded_len,
                    pad_edges_for_mesh, shard_count)
-from ..ops import scan_analytics
+from ..ops import ingress_pipeline, scan_analytics
 from ..ops import segment as seg_ops
 from ..ops import triangles, unionfind
 
@@ -318,6 +318,21 @@ def _resolve_table_mode_uncached() -> str:
     perf = triangles._load_matching_perf()
     if perf is not None:
         row = perf.get("sharded_table", {})
+        # the section records ITS OWN backend next to the file-level
+        # one ("cpu-virtual-mesh" rows ride along inside a chip-labeled
+        # PERF.json): require it to match the LIVE backend, so virtual-
+        # mesh rows can never drive a TPU process's replicated-vs-owner
+        # selection (ADVICE r5 medium finding). The virtual mesh IS the
+        # cpu backend, so "cpu-virtual-mesh" matches a cpu process.
+        try:
+            import jax as _jax
+
+            live = _jax.default_backend()
+        except Exception:
+            return "replicated"
+        row_backend = row.get("backend")
+        if row_backend not in (live, "%s-virtual-mesh" % live):
+            return "replicated"
         owner = row.get("owner_edges_per_s") or 0
         repl = row.get("replicated_edges_per_s") or 0
         # parity gate first, same as the dense selection: a fast mode
@@ -563,6 +578,9 @@ class ShardedTriangleWindowKernel:
             self.kb)
         self.cap = min(max(8, cap_factor * (self.eb // n) // n),
                        self.eb // n)
+        # per-stage counters of the shared ingress pipeline (same
+        # contract as TriangleWindowKernel.stage_timers)
+        self.stage_timers = ingress_pipeline.StageTimers()
         self._fns = {}
 
     def _fn(self, kb, cap):
@@ -660,25 +678,42 @@ class ShardedTriangleWindowKernel:
 
     def _run_stack(self, s, d, valid, get_window) -> list:
         """Dispatch a [W, eb] window stack in MAX_STREAM_WINDOWS chunks
-        (edge axis sharded over the mesh); `get_window(w)` returns the
-        raw (src, dst) of window w for the rare exact overflow recount.
-        Ragged final chunks pad the window axis to a power-of-two
-        bucket so varying stream lengths reuse O(log) compiled
-        programs."""
+        (edge axis sharded over the mesh) through the SAME three-stage
+        ingress pipeline as the single-chip kernel
+        (ops/ingress_pipeline.run_pipeline) — the sharded path keeps
+        its own table contract and mesh sharding, only the chunk loop
+        is shared: prep (pad_window_chunk) runs on the worker pool,
+        h2d is the mesh-sharded device_put, and each chunk's d2h +
+        overflow recount materializes one chunk behind its dispatch.
+        `get_window(w)` returns the raw (src, dst) of window w for the
+        rare exact overflow recount. Ragged final chunks pad the
+        window axis to a power-of-two bucket so varying stream lengths
+        reuse O(log) compiled programs."""
         sharding = self._chunk_sharding()
         num_w = s.shape[0]
         counts: list = []
-        for at in range(0, num_w, self.MAX_STREAM_WINDOWS):
+
+        def prep(at):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
             sc, dc, vc, n = seg_ops.pad_window_chunk(
                 s, d, valid, at, hi, self.MAX_STREAM_WINDOWS, self.eb,
                 self.vb)
-            args = (jax.device_put(sc, sharding),
-                    jax.device_put(dc, sharding),
-                    jax.device_put(vc, sharding))
-            fn = self._stream_exec(sc.shape[0])
+            return at, n, (sc, dc, vc)
+
+        def h2d(payload):
+            at, n, args = payload
+            return at, n, tuple(jax.device_put(a, sharding)
+                                for a in args)
+
+        def dispatch(dev_payload):
+            at, n, dev = dev_payload
+            fn = self._stream_exec(dev[0].shape[0])
+            return (at, n) + tuple(fn(*dev))
+
+        def finalize(raw):
+            at, n = raw[:2]
             # np.array (not asarray): device outputs are read-only views
-            c, b_ovf, k_ovf = (np.array(x)[:n] for x in fn(*args))
+            c, b_ovf, k_ovf = (np.array(x)[:n] for x in raw[2:])
             for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
                 ws, wd = get_window(at + int(w))
                 c[w] = self.count(
@@ -686,6 +721,10 @@ class ShardedTriangleWindowKernel:
                     failed_kb=self.kb if int(k_ovf[w]) else 0,
                     failed_cap=self.cap if int(b_ovf[w]) else 0)
             counts.extend(int(x) for x in c)
+
+        ingress_pipeline.run_pipeline(
+            range(0, num_w, self.MAX_STREAM_WINDOWS),
+            prep, h2d, dispatch, finalize, timers=self.stage_timers)
         return counts
 
     def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
@@ -1064,15 +1103,14 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
             table=self._tri.table)
         self.reset()
 
-    def _dispatch_async(self, s, d, valid):
+    def _h2d(self, args):
         from jax.sharding import NamedSharding
 
         sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
-        self._carry, res = self._run(
-            self._carry,
-            jax.device_put(s, sharding),
-            jax.device_put(d, sharding),
-            jax.device_put(valid, sharding))
+        return tuple(jax.device_put(a, sharding) for a in args)
+
+    def _dispatch_async(self, s, d, valid):
+        self._carry, res = self._run(self._carry, s, d, valid)
         return res
 
     def _materialize(self, raw):

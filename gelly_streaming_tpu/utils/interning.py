@@ -43,6 +43,74 @@ class IncrementalInterner:
         return [self._to_id[i] for i in dense.tolist()]
 
 
+def parallel_intern_arrays(interner, arrays):
+    """Intern several arrays with the heavy per-element work spread
+    across the ingress prep pool while producing EXACTLY the slot
+    assignment of interning them sequentially in order.
+
+    Scheme (deterministic by construction, not by locking):
+      1. parallel: per array, the FIRST-OCCURRENCE-ordered unique ids
+         (np.unique + argsort of first indices — pure numpy, GIL-
+         dropping) and the inverse map back to positions;
+      2. sequential: intern only those unique lists, in array order —
+         new ids meet the interner in the same first-occurrence order
+         the sequential loop would present, so slots are identical;
+      3. parallel: scatter the dense unique slots back through each
+         array's inverse map.
+    The sequential core shrinks from O(total elements) hash-map work
+    to O(total uniques). Falls back to plain sequential interning when
+    the pool is disabled — same outputs either way (the worker-pool
+    determinism contract).
+
+    Returns (dense_arrays, sizes): sizes[i] = len(interner) after
+    array i — the per-window vertex cursor the driver's snapshot
+    slicing needs."""
+    from ..ops import ingress_pipeline
+
+    arrays = [np.asarray(a) for a in arrays]
+    # np.unique needs ORDERABLE elements, and floats are excluded too:
+    # np.unique collapses NaNs into one value while the dict-based
+    # interner gives every NaN its own slot (NaN != NaN), which would
+    # make slots pool-dependent. Non-qualifying streams (object
+    # arrays — the Python interner's arbitrary-hashable contract —
+    # and float ids) take the sequential loop regardless of the pool,
+    # so the parallel scheme never changes accepted inputs or slots.
+    orderable = all(a.dtype.kind in "biuSU" for a in arrays)
+    if (not orderable or not ingress_pipeline.pipeline_enabled()
+            or len(arrays) < 2):
+        out = []
+        sizes = []
+        for a in arrays:
+            out.append(interner.intern_array(a))
+            sizes.append(len(interner))
+        return out, sizes
+
+    def uniques(a):
+        if a.size == 0:
+            return a, np.zeros(0, np.int64)
+        uniq, first, inv = np.unique(a, return_index=True,
+                                     return_inverse=True)
+        order = np.argsort(first, kind="stable")
+        rank = np.empty(len(order), np.int64)
+        rank[order] = np.arange(len(order))
+        return uniq[order], rank[inv.reshape(-1)]
+
+    pairs = ingress_pipeline.map_ordered(uniques, arrays)
+    dense = []
+    sizes = []
+    for u, _inv in pairs:
+        dense.append(interner.intern_array(u))
+        sizes.append(len(interner))
+
+    def scatter(i):
+        d, (_u, inv) = dense[i], pairs[i]
+        return (d[inv].astype(np.int32) if len(d)
+                else np.zeros(0, np.int32))
+
+    return ingress_pipeline.map_ordered(scatter,
+                                        range(len(arrays))), sizes
+
+
 def make_interner(ids_sample: np.ndarray = None):
     """Pick the native C++ interner for integer id streams, the Python
     one otherwise (or when the native library can't build)."""

@@ -162,6 +162,92 @@ def test_compact_stream_id_65535():
     assert int(np.array(c)[0]) == 1
 
 
+def test_compact_parity_at_vb_65536_boundary():
+    """vb=65536 is the LAST supported bucket (ids ≤ 65535 fit uint16):
+    end-to-end counts through the compact kernel must match the
+    standard path there, with real ids at the top of the range."""
+    vb, eb = 65536, 64
+    rng = np.random.default_rng(44)
+    # ids clustered at the top of the uint16 range + a known triangle
+    src = np.concatenate([
+        rng.integers(65000, vb, 200),
+        np.array([65535, 65534, 65533])]).astype(np.int32)
+    dst = np.concatenate([
+        rng.integers(65000, vb, 200),
+        np.array([65534, 65533, 65535])]).astype(np.int32)
+    keep = src != dst
+    src, dst = src[keep], dst[keep]
+    std = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                               ingress="standard")
+    cmp_ = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                ingress="compact")
+    want = std._count_stream_device(src, dst)
+    assert cmp_._count_stream_device(src, dst) == want
+    assert sum(want) > 0
+
+
+def test_vb_gate_falls_back_to_standard_everywhere(tmp_path,
+                                                   monkeypatch):
+    """With committed WINNING ingress_ab rows, every engine adopts
+    compact — except when supports(vb) is false (vb > 65536), where
+    each resolves standard instead of wrapping ids."""
+    import json
+
+    import jax
+
+    from gelly_streaming_tpu.ops import triangles as tri_mod
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+    from gelly_streaming_tpu.ops.windowed_reduce import (
+        WindowedEdgeReduce)
+
+    perf = tmp_path / "PERF.json"
+    perf.write_text(json.dumps({
+        "backend": jax.default_backend(),
+        "ingress_ab": [{"probe": "stream_ab", "parity": True,
+                        "speedup": 1.5}]}))
+    monkeypatch.setattr(tri_mod, "_PERF_PATH", str(perf))
+    monkeypatch.setattr(tri_mod, "_INGRESS", None)
+    try:
+        small = dict(edge_bucket=64, vertex_bucket=256)
+        big = dict(edge_bucket=64, vertex_bucket=1 << 17)
+        assert TriangleWindowKernel(**small).ingress == "compact"
+        assert TriangleWindowKernel(**big).ingress == "standard"
+        assert StreamSummaryEngine(**small).ingress == "compact"
+        assert StreamSummaryEngine(**big).ingress == "standard"
+        assert WindowedEdgeReduce(vertex_bucket=256,
+                                  edge_bucket=64).ingress == "compact"
+        assert WindowedEdgeReduce(vertex_bucket=1 << 17,
+                                  edge_bucket=64).ingress == "standard"
+        # an explicit compact pin past the gate is an ERROR everywhere
+        with pytest.raises(ValueError):
+            StreamSummaryEngine(ingress="compact", **big)
+        with pytest.raises(ValueError):
+            WindowedEdgeReduce(vertex_bucket=1 << 17, edge_bucket=64,
+                               ingress="compact")
+    finally:
+        monkeypatch.undo()
+        tri_mod._INGRESS = None
+
+
+def test_compact_reduce_rejects_out_of_range_ids():
+    """Ids the uint16 cast would wrap must fail as loudly through the
+    compact reduce prep as the host tier does."""
+    from gelly_streaming_tpu.ops.windowed_reduce import (
+        WindowedEdgeReduce)
+
+    eng = WindowedEdgeReduce(vertex_bucket=256, edge_bucket=64,
+                             name="sum", direction="out",
+                             ingress="compact")
+    ok = np.array([1], np.int64)
+    for bad in (np.array([70000], np.int64),
+                np.array([-3], np.int64)):  # both wrap through uint16
+        # plain ValueError, same as every other tier (validated on the
+        # main thread, never wrapped by the pipeline's PrepError)
+        with pytest.raises(ValueError, match="outside \\[0"):
+            eng._device_process_stream(bad, ok, np.ones(1, np.int32))
+
+
 def test_compact_overflow_recount_exact():
     """A hub whose oriented degree overflows the pinned K must be
     recounted exactly through the compact dispatch path (the shared

@@ -615,8 +615,13 @@ def test_stream_prefetch_parity_and_error_propagation(monkeypatch):
     single-threaded form (GS_STREAM_PREFETCH=0) return identical
     counts in window order; a prep failure mid-stream surfaces as the
     original exception, not a hang or a truncated result."""
+    # ingress pinned standard: the hand-built bad_chunk below fabricates
+    # STANDARD-format stacks, and committed winning ingress_ab rows
+    # would otherwise resolve the kernel compact (this test pins the
+    # pipeline loop's contract, not the wire-format selection)
     kern = tri_ops.TriangleWindowKernel(edge_bucket=256,
-                                       vertex_bucket=128)
+                                       vertex_bucket=128,
+                                       ingress="standard")
     kern.MAX_STREAM_WINDOWS = 4   # many chunks: 16 windows -> 4 chunks
     rng = np.random.default_rng(11)
     src = rng.integers(0, 128, 16 * 256).astype(np.int32)

@@ -202,6 +202,35 @@ def test_native_reduce_rejects_out_of_range_ids():
                                    "sum", "out", 0)
 
 
+@needs_native_reduce
+def test_native_i32_output_gate_covers_counts_slab():
+    """The int32-output fast form is gated on the COUNTS slab too: a
+    cell can receive up to 2·eb contributions regardless of the
+    reduce op, so min/max and the all-zero-sum case (where the old
+    value-only bound 0 × per_cell passed vacuously) must fall back to
+    int64 slabs whenever 2*eb exceeds INT32_MAX. Normal window sizes
+    keep the int32 fast path."""
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.ops.windowed_reduce import _host_identity
+
+    src = np.array([0, 1, 2], np.int32)
+    dst = np.array([1, 2, 0], np.int32)
+    ones = np.ones(3, np.int32)
+    huge_eb = (1 << 30) + 1           # 2*eb > INT32_MAX, n stays tiny
+    for name, val in (("min", ones), ("max", ones),
+                      ("sum", np.zeros(3, np.int32))):
+        cells, counts = native.windowed_reduce(
+            src, dst, val, huge_eb, 8, name, "all",
+            int(_host_identity(name, val.dtype)))
+        assert counts.dtype == np.int64, (name, counts.dtype)
+        assert cells.dtype == np.int64, (name, cells.dtype)
+    if native.windowed_reduce_available() and hasattr(
+            native._load(), "gs_windowed_reduce_i32o"):
+        cells, counts = native.windowed_reduce(
+            src, dst, ones, 8, 8, "min", "all", int(2 ** 31 - 1))
+        assert counts.dtype == np.int32   # the fast path still fires
+
+
 def test_host_sum_fast_path_rejects_out_of_range_ids():
     """The per-window bincount fast path must raise (like the
     flattened path's reshape did), not emit a ragged window."""

@@ -473,10 +473,12 @@ def test_resolve_table_mode_flips_on_committed_measurement(
     monkeypatch.setattr(tri_ops, "_PERF_PATH", str(perf_path))
     backend = jax.default_backend()
 
-    def write(file_backend, owner, repl, counts_match=True):
+    def write(file_backend, owner, repl, counts_match=True,
+              row_backend=None):
         perf_path.write_text(json.dumps({
             "backend": file_backend,
-            "sharded_table": {"owner_edges_per_s": owner,
+            "sharded_table": {"backend": row_backend or file_backend,
+                              "owner_edges_per_s": owner,
                               "replicated_edges_per_s": repl,
                               "counts_match": counts_match}}))
 
@@ -494,6 +496,26 @@ def test_resolve_table_mode_flips_on_committed_measurement(
     assert sharded.resolve_table_mode() == "replicated"
     # a fast mode whose own evidence says it miscounted never wins
     write(backend, owner=2000, repl=1000, counts_match=False)
+    sharded._reset_table_mode()
+    assert sharded.resolve_table_mode() == "replicated"
+    # the section's OWN backend label must match the LIVE backend:
+    # virtual-mesh rows riding inside a chip-labeled PERF.json can
+    # never drive a TPU process's selection (ADVICE r5); the virtual
+    # mesh IS the cpu backend, so "<live>-virtual-mesh" still matches
+    write(backend, owner=2000, repl=1000,
+          row_backend="some-other-backend")
+    sharded._reset_table_mode()
+    assert sharded.resolve_table_mode() == "replicated"
+    write(backend, owner=2000, repl=1000,
+          row_backend="%s-virtual-mesh" % backend)
+    sharded._reset_table_mode()
+    assert sharded.resolve_table_mode() == "owner"
+    # a row with NO backend label is treated as unmatched evidence
+    perf_path.write_text(json.dumps({
+        "backend": backend,
+        "sharded_table": {"owner_edges_per_s": 2000,
+                          "replicated_edges_per_s": 1000,
+                          "counts_match": True}}))
     sharded._reset_table_mode()
     assert sharded.resolve_table_mode() == "replicated"
     # don't leak a resolution made against the fake PERF.json

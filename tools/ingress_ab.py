@@ -189,27 +189,89 @@ def stream_ab(jax, jnp, num_edges, results):
     print(json.dumps(row), flush=True)
 
 
+PROBE_NAMES = ("latency", "h2d", "device_compute", "stream_ab")
+
+
+def commit_results(results, backend: str) -> None:
+    """Merge this run's rows into the committed evidence under the
+    same policy as tools/profile_kernels.py's flush: `ingress_ab`
+    carries ONLY the stream_ab rows (resolve_ingress's gate checks
+    parity+speedup on every row), the other probes land under
+    `ingress_probes`; PERF.json updates only when its backend label
+    matches the LIVE backend (a CPU run never overwrites chip-labeled
+    selections), while the per-backend archive PERF_<backend>.json
+    always takes the rows (ops/triangles._load_matching_perf reads it
+    when PERF.json belongs to the other backend). Only keys this run
+    produced are replaced — a stream_ab-only run keeps the committed
+    bandwidth/latency probes."""
+    ab = [r for r in results if r.get("probe") == "stream_ab"]
+    probes = [r for r in results if r.get("probe") != "stream_ab"]
+    targets = ((os.path.join(REPO, "PERF.json"), True),
+               (os.path.join(REPO, "PERF_%s.json" % backend), False))
+    for path, need_match in targets:
+        try:
+            with open(path) as f:
+                cur = json.load(f)
+        except (OSError, ValueError):
+            cur = {}
+        if need_match and cur.get("backend") != backend:
+            print("not committing to %s: file backend %r != live %r"
+                  % (os.path.basename(path), cur.get("backend"),
+                     backend), file=sys.stderr)
+            continue
+        cur.setdefault("backend", backend)
+        if ab:
+            cur["ingress_ab"] = ab
+        if probes:
+            cur["ingress_probes"] = probes
+        with open(path, "w") as f:
+            json.dump(cur, f, indent=2)  # the profiler's format
+        print("committed %s row(s) to %s"
+              % (len(ab) + len(probes), os.path.basename(path)),
+              flush=True)
+
+
 def main():
     import argparse
 
     ap = argparse.ArgumentParser()
+    # validated by hand, not via `choices`: argparse on Python <= 3.11
+    # rejects an EMPTY nargs='*' list against choices, which would
+    # break the documented no-argument run-everything invocation
+    ap.add_argument("probes", nargs="*",
+                    help="subset of %s to run (default: all)"
+                         % (PROBE_NAMES,))
     ap.add_argument("--edges", type=int,
                     default=int(os.environ.get("GS_AB_EDGES", 10_485_760)))
+    ap.add_argument("--commit", action="store_true",
+                    help="merge rows into PERF.json (backend-matched) "
+                         "and PERF_<backend>.json")
     args = ap.parse_args()
+    bad = [p for p in args.probes if p not in PROBE_NAMES]
+    if bad:
+        ap.error("unknown probe(s) %s; valid: %s"
+                 % (bad, list(PROBE_NAMES)))
+    want = args.probes or list(PROBE_NAMES)
 
     import jax
     import jax.numpy as jnp
 
     results = []
-    latency_probe(jax, jnp, results)
-    h2d_probe(jax, jnp, 32768, 16, results)
-    device_compute_probe(jax, jnp, results)
-    stream_ab(jax, jnp, args.edges, results)
+    if "latency" in want:
+        latency_probe(jax, jnp, results)
+    if "h2d" in want:
+        h2d_probe(jax, jnp, 32768, 16, results)
+    if "device_compute" in want:
+        device_compute_probe(jax, jnp, results)
+    if "stream_ab" in want:
+        stream_ab(jax, jnp, args.edges, results)
     out = os.path.join(REPO, "logs",
                        "ingress_ab_%s.json" % jax.default_backend())
     with open(out, "w") as f:
         json.dump(results, f, indent=1)
     print("wrote %s" % out, flush=True)
+    if args.commit:
+        commit_results(results, jax.default_backend())
 
 
 if __name__ == "__main__":

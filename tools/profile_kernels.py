@@ -649,6 +649,95 @@ def section_host_stream(results: dict) -> None:
     results["host_stream"] = out
 
 
+def section_pipeline(results: dict) -> None:
+    """Per-stage (prep ms / h2d ms / compute ms per chunk)
+    decomposition of the pipelined stream dispatch
+    (ops/ingress_pipeline.StageTimers) plus a pipelined-vs-forced-sync
+    A/B of the device path at both bench buckets and both wire
+    formats — committed so the next tunnel window can decompose the
+    chip-side wall (host prep vs transfer vs compute) without new
+    instrumentation. Counts parity is asserted into the row, never
+    assumed."""
+    from gelly_streaming_tpu.ops import compact_ingress, ingress_pipeline
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+    from gelly_streaming_tpu.ops.windowed_reduce import WindowedEdgeReduce
+
+    rows = []
+    for eb, ingress in ((8_192, "standard"), (32_768, "standard"),
+                        (32_768, "compact")):
+        vb = 2 * eb
+        if ingress == "compact" and not compact_ingress.supports(vb):
+            continue
+        num_w = 64
+        src, dst = _stream(num_w * eb, vb)
+        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb,
+                                    ingress=ingress)
+        got = {}
+
+        def run_pipe():
+            got["pipe"] = kern._count_stream_device(src, dst)
+
+        def run_sync():
+            with ingress_pipeline.forced_sync():
+                got["sync"] = kern._count_stream_device(src, dst)
+
+        run_pipe()                       # compile + warm
+        kern.stage_timers.reset()        # timers cover timed reps only
+        t_pipe = _timeit(run_pipe, reps=3, warmup=0)
+        snap = kern.stage_timers.snapshot()
+        t_sync = _timeit(run_sync, reps=3, warmup=0)
+        row = {
+            "engine": "triangle_stream", "edge_bucket": eb,
+            "ingress": ingress, "windows": num_w,
+            "windows_per_dispatch": kern.MAX_STREAM_WINDOWS,
+            "workers": ingress_pipeline.worker_count(),
+            "parity": got["pipe"] == got["sync"],
+            "pipelined_edges_per_s": round(num_w * eb / t_pipe),
+            "sync_edges_per_s": round(num_w * eb / t_sync),
+            "pipeline_speedup": round(t_sync / t_pipe, 2),
+            **snap,
+        }
+        rows.append(row)
+        print(json.dumps({"pipeline_progress": row}), flush=True)
+
+    # one windowed-reduce row: the second engine routed through the
+    # pipeline (BASELINE config #2's device path)
+    eb, nv, num_w = 8_192, 16_384, 64
+    src, dst = _stream(num_w * eb, nv)
+    val = (1 + (src + 3 * dst) % 97).astype(np.int32)
+    eng = WindowedEdgeReduce(vertex_bucket=nv, edge_bucket=eb,
+                             name="sum", direction="out")
+    s64, d64 = src.astype(np.int64), dst.astype(np.int64)
+    got = {}
+
+    def r_pipe():
+        got["pipe"] = eng._device_process_stream(s64, d64, val)
+
+    def r_sync():
+        with ingress_pipeline.forced_sync():
+            got["sync"] = eng._device_process_stream(s64, d64, val)
+
+    r_pipe()
+    eng.stage_timers.reset()
+    t_pipe = _timeit(r_pipe, reps=3, warmup=0)
+    snap = eng.stage_timers.snapshot()
+    t_sync = _timeit(r_sync, reps=3, warmup=0)
+    rows.append({
+        "engine": "windowed_reduce", "edge_bucket": eb,
+        "ingress": eng.ingress, "windows": num_w,
+        "workers": ingress_pipeline.worker_count(),
+        "parity": all(
+            np.array_equal(ca, cb) and np.array_equal(na, nb)
+            for (ca, na), (cb, nb) in zip(got["pipe"], got["sync"])),
+        "pipelined_edges_per_s": round(num_w * eb / t_pipe),
+        "sync_edges_per_s": round(num_w * eb / t_sync),
+        "pipeline_speedup": round(t_sync / t_pipe, 2),
+        **snap,
+    })
+    print(json.dumps({"pipeline_progress": rows[-1]}), flush=True)
+    results["pipeline_stages"] = rows
+
+
 def section_host_reduce(results: dict) -> None:
     """Columnar windowed-reduce tiers (ops/windowed_reduce.py): device
     segment kernels vs the vectorized host kernel, per monoid — the
@@ -1167,6 +1256,7 @@ SECTIONS = {
     "ingress_ab": section_ingress_ab,
     "window": section_window,
     "host_stream": section_host_stream,
+    "pipeline_stages": section_pipeline,
     "host_reduce": section_host_reduce,
     "host_snapshot": section_host_snapshot,
     "compile_probe": section_compile_probe,
