@@ -31,16 +31,20 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import List, Optional, Sequence
 
 import numpy as np
 
 from .. import native
+from ..ops import host_snapshot
 from ..ops import ingress_pipeline
 from ..ops import segment as seg_ops
 from ..ops import triangles as tri_ops
 from ..ops import unionfind
 from ..utils import checkpoint
+from ..utils import faults
+from ..utils import resilience
 from ..utils.interning import make_interner, parallel_intern_arrays
 from ..utils.tracing import StepTimer
 
@@ -228,7 +232,7 @@ class StreamingAnalyticsDriver:
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
-        if snapshot_tier not in (None, "scan", "native"):
+        if snapshot_tier not in (None, "scan", "native", "host"):
             raise ValueError(f"unknown snapshot_tier: {snapshot_tier!r}")
         if snapshot_tier == "native" and not native.snapshot_available():
             raise ValueError("native snapshot tier pinned but "
@@ -257,9 +261,15 @@ class StreamingAnalyticsDriver:
         self.edges_done = 0       # count-based window_start offset
         self._closed_partial = False  # count-based misuse guard
         self._ckpt_path = None
-        self._ckpt_every = 0
+        self._ckpt_policy = None  # utils.checkpoint.CheckpointPolicy
         self._pending_ckpt = []  # staged (windows_done, state) — see
         self._emitted = None     # _stage_ckpt; not-None inside stream_file
+        # tier demotion (utils/resilience): a persistent device failure
+        # in the batched snapshot path demotes scan→native→host
+        # mid-stream instead of killing the job; None = not demoted
+        self._demoted_tier = None
+        self._demoted_at = 0      # windows_done when last demoted
+        self._demotions = []      # event dicts (also in the registry)
 
     def reset(self) -> None:
         """Clear all carried stream state (interner, analytics vectors,
@@ -275,6 +285,11 @@ class StreamingAnalyticsDriver:
         self.edges_done = 0
         self._closed_partial = False
         self._pending_ckpt = []
+        if self._ckpt_policy is not None:
+            # re-anchor the cadence: the cursor just rewound to 0, so
+            # a stale high-water mark would suppress every due() until
+            # the stream re-passed it
+            self._ckpt_policy.mark(0)
         if self._engine is not None:
             self._engine.reset()
 
@@ -619,20 +634,106 @@ class StreamingAnalyticsDriver:
         nv_final = len(self.interner)
         max_len = max(len(s) for _w, s, _d, _n in interned)
         self._ensure_buckets(nv_final, max_len)
-        vb = self.vb
 
+        # tier-demotion loop: chunks are the consistency unit (mirrors,
+        # cursors, checkpoints move together at each boundary), so a
+        # persistent device failure at chunk k leaves the driver
+        # resumable at the last finalized chunk — demote the snapshot
+        # tier (scan→native→host), rebuild the carried state from the
+        # mirrors, and continue with the NOT-yet-finalized windows.
+        results: List[WindowResult] = []
+        while True:
+            tier = self._effective_tier()
+            try:
+                self._scan_interned(interned[len(results):], results,
+                                    closes_partial, tier)
+                return results
+            except resilience.StageError as e:
+                if not self._maybe_demote(tier, e):
+                    raise
+
+    def _effective_tier(self) -> str:
+        """The snapshot tier the next chunk runs on: a live demotion
+        wins over the pin/resolution; after GS_TIER_RETRY_WINDOWS
+        windows of probation on the demoted tier, one re-promotion
+        probe runs the higher tier again (a repeat failure re-demotes
+        and restarts probation)."""
+        if self._demoted_tier is not None:
+            n = resilience.tier_retry_windows()
+            if n and self.windows_done - self._demoted_at >= n:
+                event = resilience.record_demotion(
+                    "snapshot", self._demoted_tier,
+                    self._snapshot_tier or resolve_snapshot_tier(),
+                    self.windows_done,
+                    "re-promotion probe after %d probation windows"
+                    % (self.windows_done - self._demoted_at))
+                self._demotions.append(event)
+                if self.timer:
+                    self.timer.event("tier_repromotion", event)
+                self._demoted_tier = None
+            else:
+                return self._demoted_tier
+        return self._snapshot_tier or resolve_snapshot_tier()
+
+    def _maybe_demote(self, tier: str, err: BaseException) -> bool:
+        """Decide whether `err` on `tier` demotes to the next ladder
+        rung. Only failure shapes a tier change can plausibly cure
+        demote — stage timeouts and wrapped runtime/OS-level failures
+        (a wedged tunnel, a dead device, an injected fault); semantic
+        errors (ValueError/TypeError/...) re-raise so a programming
+        bug is never silently 'fixed' by falling off the fast tier."""
+        if self.mesh is not None \
+                or not resilience.tier_demotion_enabled():
+            return False  # sharded engines have no host twin
+        if not isinstance(err, resilience.StageTimeout):
+            cause = err.__cause__
+            if not isinstance(cause, (RuntimeError, OSError,
+                                      MemoryError)):
+                return False
+        order = ("scan", "native", "host")
+        for nxt in order[order.index(tier) + 1:]:
+            if nxt == "native" and not native.snapshot_available():
+                continue
+            event = resilience.record_demotion(
+                "snapshot", tier, nxt, self.windows_done,
+                "%s: %s" % (type(err).__name__, err))
+            self._demotions.append(event)
+            if self.timer:
+                self.timer.event("tier_demotion", event)
+            self._demoted_tier = nxt
+            self._demoted_at = self.windows_done
+            return True
+        return False
+
+    def demotion_log(self) -> List[dict]:
+        """Demotion/re-promotion events of this driver's lifetime (the
+        process-global registry in utils/resilience feeds PERF.json's
+        `degradations` section; this is the per-driver view)."""
+        return list(self._demotions)
+
+    def _scan_interned(self, interned, results, closes_partial: bool,
+                       tier: str) -> None:
+        """Run the batched snapshot analytics over `interned`
+        [(wstart, s, d, nv)] on `tier`, appending per-window results.
+        Carried state is (re)built from the chunk-boundary sources —
+        host mirrors / engine state — at entry, which is what makes
+        the call re-enterable after a mid-stream tier demotion."""
+        import jax.numpy as jnp
+
+        if not interned:
+            return
+        vb = self.vb
         run_scan = any(a in self.analytics
                        for a in ("degrees", "cc", "bipartite"))
         sharded = self._engine is not None
-        # host tier of the snapshot stage (CPU fallback): carried C++
-        # union-find + degree fold producing the SAME per-window `outs`
+        # native/host tiers of the snapshot stage: carried union-find
+        # + degree fold (C++ or numpy — bit-exact twins) producing the
+        # SAME per-window `outs`
         # stacks as the scan — including, under emit_deltas, the
         # changed-slot masks (host-diffed against the chunk-start
         # snapshot below, same semantics as the scan's device masks).
         native_state = None
-        if (run_scan and not sharded
-                and (self._snapshot_tier or resolve_snapshot_tier())
-                == "native"):
+        if run_scan and not sharded and tier in ("native", "host"):
             deg32 = lab = cov = None
             if "degrees" in self.analytics:
                 deg32 = np.zeros(self.vb, np.int32)
@@ -673,7 +774,6 @@ class StreamingAnalyticsDriver:
             carry = (jnp.asarray(deg0), jnp.asarray(lab0),
                      jnp.asarray(cov0))
 
-        results = []
         num_w = len(interned)
         scan_chunk = self._scan_chunk()
         # Depth-2 pipeline over the DEVICE scan branch: the scan carry
@@ -766,7 +866,6 @@ class StreamingAnalyticsDriver:
                     self._cc = outs["labels"][last][:nv_chunk].copy()
                 if "cover" in outs:
                     self._bip = outs["cover"][last][:2 * vb].copy()
-            prev_done = self.windows_done
             self.windows_done += len(chunk)
             self.edges_done += sum(
                 len(s) for _w, s, _d, _n in chunk)
@@ -775,9 +874,7 @@ class StreamingAnalyticsDriver:
                 # joins this boundary's state (and its checkpoint),
                 # never an earlier one's
                 self._closed_partial = True
-            if (self._ckpt_path and self._ckpt_every
-                    and self.windows_done // self._ckpt_every
-                    > prev_done // self._ckpt_every):
+            if self._ckpt_due():
                 self._stage_ckpt()
 
         pending = None  # (at, chunk, device outs)
@@ -790,7 +887,16 @@ class StreamingAnalyticsDriver:
             pending = None
             with self._step("snapshot_wait",
                             sum(len(s) for _w, s, _d, _n in f_chunk)):
-                f_outs = {k: np.asarray(v) for k, v in f_outs.items()}
+                # the materialize leg of the snapshot path: a hung d2h
+                # through a wedged tunnel surfaces as a typed
+                # StageTimeout (deadline only — the d2h is a pure read,
+                # but a retry would re-block on the same dead transfer)
+                def _mat(f_outs=f_outs):
+                    faults.fire("finalize")
+                    return {k: np.asarray(v) for k, v in f_outs.items()}
+
+                f_outs = resilience.call_guarded(
+                    "finalize", f_at, _mat, retries=0)
             _finalize_chunk(f_at, f_chunk, f_outs)
 
         # prep stage of the device-scan branch: the [wb, eb] stack
@@ -807,9 +913,13 @@ class StreamingAnalyticsDriver:
                 [(s, d) for _w, s, d, _n in chunk], wb, self.eb, vb)
             return wb, s_w, d_w, valid
 
-        prefetched = None  # (at, future) for the next chunk's stacks
+        prefetched = None  # (at, future, item) for next chunk's stacks
+        fold = (native.snapshot_windows if tier == "native"
+                else host_snapshot.snapshot_windows)
 
-        for at in range(0, num_w, scan_chunk):
+        def _chunk_loop():
+          nonlocal carry, native_state, pending, prefetched
+          for at in range(0, num_w, scan_chunk):
             chunk = interned[at:at + scan_chunk]
             outs = {}
             if run_scan and native_state is not None:
@@ -824,8 +934,17 @@ class StreamingAnalyticsDriver:
                                for a in native_state)
                          if self.emit_deltas else None)
                 with self._step("snapshot_scan", len(flat_s)):
-                    outs = native.snapshot_windows(
-                        flat_s, flat_d, offs, self.vb, *native_state)
+                    # guarded, NEVER retried: the fold mutates the
+                    # chunk-local carried copies in place. A failure
+                    # demotes (native→host) and _scan_interned
+                    # re-enters with fresh copies off the mirrors.
+                    def _fold(flat_s=flat_s, flat_d=flat_d, offs=offs):
+                        faults.fire("dispatch")
+                        return fold(flat_s, flat_d, offs, self.vb,
+                                    *native_state)
+
+                    outs = resilience.call_guarded(
+                        "dispatch", at, _fold, retries=0)
                 if prevs is not None:
                     # changed-slot masks vs the previous window's
                     # snapshot (row -1 = chunk-start carried state) —
@@ -849,7 +968,24 @@ class StreamingAnalyticsDriver:
                         outs["_odd_rows"] = odd  # reused at extraction
             elif run_scan:
                 if prefetched is not None and prefetched[0] == at:
-                    wb, s_w, d_w, valid = prefetched[1].result()
+                    timeout = resilience.stage_timeout_s()
+                    try:
+                        wb, s_w, d_w, valid = prefetched[1].result(
+                            timeout=2 * timeout if timeout > 0
+                            else None)
+                    except BaseException as e:
+                        # interrupts and the simulated hard kill pass
+                        # through; any other failure (a hung worker's
+                        # _FutureTimeout, a transient PrepError) gets
+                        # the guard's retry budget — prep is pure, so
+                        # the inline rebuild is always safe
+                        if (not isinstance(e, Exception)
+                                or ingress_pipeline._is_fatal(e)
+                                or not resilience.guard_active()):
+                            raise  # inert knobs keep legacy fail-fast
+                        wb, s_w, d_w, valid = resilience.call_guarded(
+                            "prep", at,
+                            lambda: _build_stack(prefetched[2]))
                 else:
                     wb, s_w, d_w, valid = _build_stack(
                         (chunk, self._scan_wb(len(chunk))))
@@ -862,19 +998,32 @@ class StreamingAnalyticsDriver:
                 nxt = at + scan_chunk
                 if nxt < num_w:
                     nxt_chunk = interned[nxt:nxt + scan_chunk]
+                    nxt_item = (nxt_chunk,
+                                self._scan_wb(len(nxt_chunk)))
                     fut = ingress_pipeline.submit_prep(
-                        _build_stack,
-                        (nxt_chunk, self._scan_wb(len(nxt_chunk))))
+                        _build_stack, nxt_item)
                     if fut is not None:
-                        prefetched = (nxt, fut)
+                        prefetched = (nxt, fut, nxt_item)
                 with self._step("snapshot_scan",
                                 sum(len(s) for _w, s, _d, _n in chunk)):
                     # async dispatch: returns device arrays without
                     # blocking; the d2h lands in this chunk's finalize
-                    # (snapshot_wait), AFTER the next chunk is queued
-                    carry, outs = fn(carry, jnp.asarray(s_w),
-                                     jnp.asarray(d_w),
-                                     jnp.asarray(valid))
+                    # (snapshot_wait), AFTER the next chunk is queued.
+                    # Guarded WITH retries: the jitted scan is pure
+                    # (carry in, new carry out — rebound only on
+                    # success), so re-dispatching a failed chunk is
+                    # safe; exhausted retries surface as typed
+                    # StageFailed/StageTimeout and feed the demotion
+                    # ladder in _run_batched.
+                    def _disp(s_w=s_w, d_w=d_w, valid=valid,
+                              carry_in=carry):
+                        faults.fire("dispatch")
+                        return fn(carry_in, jnp.asarray(s_w),
+                                  jnp.asarray(d_w), jnp.asarray(valid))
+
+                    carry, outs = resilience.call_guarded(
+                        "dispatch", at, _disp,
+                        retries=resilience.stage_retries())
                 finalize_pending()
                 pending = (at, chunk, outs)
                 continue
@@ -883,8 +1032,21 @@ class StreamingAnalyticsDriver:
             # the whole call — the sync tiers never have one in flight
             assert pending is None
             _finalize_chunk(at, chunk, outs)
+
+        try:
+            _chunk_loop()
+        except Exception:
+            # drain the in-flight chunk before surfacing: its outputs
+            # were already dispatched, so materialize + finalize them
+            # best-effort — mirrors/cursors then sit at the last chunk
+            # the device actually completed, which is what makes the
+            # demotion re-entry (and an operator resume) exact
+            try:
+                finalize_pending()
+            except Exception:
+                pending = None
+            raise
         finalize_pending()
-        return results
 
     def _stage_ckpt(self) -> None:
         """Stage a due auto-checkpoint instead of saving it inline.
@@ -905,6 +1067,7 @@ class StreamingAnalyticsDriver:
         generator (direct
         run_arrays callers get the whole result list in the same
         action) the stage flushes immediately — the old behavior."""
+        self._ckpt_policy.mark(self.windows_done)
         snap = (self.windows_done, self.state_dict())
         if self._emitted is None:
             with self._step("checkpoint", 0):
@@ -1047,8 +1210,7 @@ class StreamingAnalyticsDriver:
             self._attach_host_deltas(res, prev)
         self.windows_done += 1
         self.edges_done += len(src)
-        if (self._ckpt_path
-                and self.windows_done % self._ckpt_every == 0):
+        if self._ckpt_due():
             self._stage_ckpt()
         return res
 
@@ -1167,55 +1329,70 @@ class StreamingAnalyticsDriver:
     # checkpoint / resume + failure recovery (utils/checkpoint.py)
     # ------------------------------------------------------------------
     def enable_auto_checkpoint(self, path: str,
-                               every_n_windows: int = 16) -> None:
-        """Snapshot all carried state to `path` (atomic replace) every N
-        processed windows — the failure-recovery hook the reference's
-        combine-fn javadoc alludes to but never implements
-        (library/ConnectedComponents.java:117-118).
+                               every_n_windows: int = 16,
+                               every_seconds: float = 0.0,
+                               policy=None) -> None:
+        """Snapshot all carried state to `path` (atomic replace +
+        last-2 rotation, utils/checkpoint.save) on a
+        `CheckpointPolicy` cadence: every N processed windows and/or
+        every T seconds, whichever comes first — the failure-recovery
+        hook the reference's combine-fn javadoc alludes to but never
+        implements (library/ConnectedComponents.java:117-118). Pass
+        `policy` (a utils.checkpoint.CheckpointPolicy) to inject a
+        deterministic clock.
 
-        Granularity: the per-window path checkpoints exactly on the
-        Nth window; the batched fast path checkpoints at its chunk
-        boundaries (every _SCAN_CHUNK=64 windows), whenever a multiple
-        of N was crossed inside the chunk — a crash loses at most
-        max(N, 64) windows of work."""
-        if every_n_windows < 1:
-            raise ValueError("every_n_windows must be >= 1")
+        Granularity: the per-window path checks the cadence on every
+        window; the batched fast path at its chunk boundaries (every
+        _SCAN_CHUNK=64 windows) — a crash loses at most
+        max(N, 64) windows of work (or one time interval plus a
+        chunk)."""
+        if policy is None:
+            if every_n_windows < 1 and every_seconds <= 0:
+                raise ValueError(
+                    "need every_n_windows >= 1 and/or every_seconds > 0")
+            policy = checkpoint.CheckpointPolicy(
+                every_n_windows=max(0, every_n_windows),
+                every_seconds=every_seconds)
+        if not policy.enabled():
+            raise ValueError("checkpoint policy has no trigger enabled")
         self._ckpt_path = path
-        self._ckpt_every = every_n_windows
+        self._ckpt_policy = policy
+
+    def _ckpt_due(self) -> bool:
+        return (self._ckpt_path is not None
+                and self._ckpt_policy.due(self.windows_done))
 
     def try_resume(self, path: str) -> bool:
         """Restore from `path` if a readable checkpoint exists; returns
         whether state was restored. After resume, `windows_done` is the
         cursor of fully-processed windows — feed the stream from there.
 
-        An UNREADABLE file (truncated/corrupt — possible only through
-        external damage, since save() writes atomically via tmp+rename)
-        behaves like a missing checkpoint: warn and return False, so
-        the caller reprocesses from the start, which is always correct.
-        SEMANTIC mismatches (cross-mode, window size) still raise from
-        load_state_dict — those need an operator decision, not a silent
-        full reprocess — and so do OPERATIONAL failures (PermissionError
-        / EIO / out-of-memory): the file may be intact, and silently
-        reprocessing a multi-million-edge stream would mask a fixable
-        problem."""
-        import os
+        An UNREADABLE generation (truncated/corrupt — possible only
+        through external damage, since save() writes atomically via
+        tmp+rename) falls back to the rotated previous checkpoint
+        (`checkpoint.load_latest`); only when every generation is
+        damaged does resume behave like a missing checkpoint: warn and
+        return False, so the caller reprocesses from the start, which
+        is always correct. SEMANTIC mismatches (cross-mode, window
+        size) still raise from load_state_dict — those need an
+        operator decision, not a silent full reprocess — and so do
+        OPERATIONAL failures (PermissionError / EIO / out-of-memory):
+        the file may be intact, and silently reprocessing a
+        multi-million-edge stream would mask a fixable problem."""
         import warnings
-        import zipfile
-        import zlib
 
-        if not os.path.exists(path):
-            return False
         try:
-            state = checkpoint.restore(path)
-        except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
-                EOFError) as e:
-            # the failure shapes np.load produces for damaged archives:
-            # truncation -> BadZipFile/EOFError, bit-flipped deflate
-            # streams -> zlib.error, mangled payloads -> ValueError/KeyError
-            warnings.warn(
-                f"checkpoint {path!r} is corrupt "
-                f"({type(e).__name__}: {e}); starting fresh")
+            got = checkpoint.load_latest(path)
+        except checkpoint.CheckpointCorrupt as e:
+            warnings.warn(f"{e}; no intact generation — starting fresh")
             return False
+        if got is None:
+            return False
+        state, used = got
+        if used != path:
+            warnings.warn(
+                f"checkpoint {path!r} is corrupt; resumed from the "
+                f"rotated previous generation {used!r}")
         self.load_state_dict(state)
         return True
 

@@ -16,6 +16,7 @@ from typing import Tuple
 import numpy as np
 
 from .. import native
+from ..utils import faults
 from ..core.datastream import DataStream
 from ..core.graphstream import SimpleEdgeStream
 from ..core.gtime import AscendingTimestampExtractor
@@ -42,11 +43,16 @@ def _iter_edge_chunks_sync(path: str, chunk_bytes: int):
                 remainder = data
                 continue
             remainder = data[cut + 1:]
-            arrays = native.parse_edge_bytes(data[:cut + 1])
+            # fault-injection point (utils/faults site "parse"): a
+            # corrupt_bytes plan garbles an edge line here, pinning
+            # that the parser DROPS a torn line without misaligning
+            # the arrays (tests/operations/test_faults.py)
+            arrays = native.parse_edge_bytes(
+                faults.fire("parse", data[:cut + 1]))
             if len(arrays[0]):
                 yield arrays
     if remainder:
-        arrays = native.parse_edge_bytes(remainder)
+        arrays = native.parse_edge_bytes(faults.fire("parse", remainder))
         if len(arrays[0]):
             yield arrays
 

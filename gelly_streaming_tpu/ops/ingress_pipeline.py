@@ -58,6 +58,27 @@ Env knobs:
                           device memory never requires shrinking prep
                           parallelism for the host-tier map_ordered
                           users.
+  GS_STAGE_TIMEOUT_S=T  — per-STAGE watchdog deadline (utils/
+                          resilience): a prep/h2d/dispatch/finalize
+                          call that exceeds T surfaces as a typed
+                          StageTimeout naming the chunk instead of
+                          stalling the stream forever (the round-5
+                          hung-tunnel shape). 0 (default) disables.
+  GS_STAGE_RETRIES=N    — bounded retry for the re-runnable stages
+                          (prep and h2d are pure/idempotent) with
+                          deterministic jitterless exponential
+                          backoff (GS_STAGE_BACKOFF_S base, default
+                          0.05 s). Side-effecting stages (dispatch,
+                          finalize) never retry — a deadline/failure
+                          there is typed and raised at once. Default
+                          0; with both knobs unset the guard is inert
+                          and the legacy inline path (and its exact
+                          exception types) runs.
+
+On ANY failure escaping the loop, in-flight device work is DRAINED:
+the already-dispatched previous chunk's finalize runs best-effort
+before the error re-raises, so its device buffers and d2h are never
+silently abandoned mid-stream.
 """
 
 from __future__ import annotations
@@ -66,9 +87,15 @@ import os
 import threading
 import time
 import traceback
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Iterable, List, Optional
 
+from ..utils import faults
+from ..utils import resilience
+from ..utils.resilience import StageFailed, StageTimeout
+
 _MAX_DEFAULT_WORKERS = 4
+_POLL_S = 0.02  # watchdog poll tick while awaiting a guarded stage
 
 
 class StageTimers:
@@ -86,10 +113,13 @@ class StageTimers:
         self.reset()
 
     def reset(self) -> None:
-        self.chunks = 0
-        self.prep_ms = 0.0
-        self.h2d_ms = 0.0
-        self.compute_ms = 0.0
+        # under the lock: a concurrent worker's add() between the
+        # field writes would otherwise be partially erased
+        with self._lock:
+            self.chunks = 0
+            self.prep_ms = 0.0
+            self.h2d_ms = 0.0
+            self.compute_ms = 0.0
 
     def add(self, stage: str, seconds: float) -> None:
         with self._lock:  # prep accumulates from several workers
@@ -208,11 +238,25 @@ def reset_pool() -> None:
         _POOL_WORKERS = None
 
 
-def _timed_prep(prep: Callable, item, timers: Optional[StageTimers]):
+def _mark(cell: Optional[dict], stage: str) -> None:
+    """Record which stage a worker task is in (and since when) so the
+    consumer-side watchdog can enforce a per-STAGE deadline and name
+    the actual hung stage. Plain dict writes: each key is written by
+    one thread and read by one other — torn reads are impossible for
+    the float/str values involved."""
+    if cell is not None:
+        cell["since"] = time.perf_counter()
+        cell["stage"] = stage
+
+
+def _timed_prep(prep: Callable, item, timers: Optional[StageTimers],
+                cell: Optional[dict] = None):
     """Worker-side prep wrapper: times the call and converts a failure
     into a PrepError carrying the formatted worker traceback."""
+    _mark(cell, "prep")
     t0 = time.perf_counter()
     try:
+        faults.fire("prep")
         out = prep(item)
     except Exception as e:
         # Exception only: KeyboardInterrupt/SystemExit must abort the
@@ -228,12 +272,15 @@ def _timed_prep(prep: Callable, item, timers: Optional[StageTimers]):
 
 
 def _prep_then_h2d(prep: Callable, h2d: Callable, item,
-                   timers: Optional[StageTimers]):
+                   timers: Optional[StageTimers],
+                   cell: Optional[dict] = None):
     """One worker task = prep + h2d of one chunk, each stage timed
     separately; h2d failures carry the worker traceback too."""
-    payload = _timed_prep(prep, item, timers)
+    payload = _timed_prep(prep, item, timers, cell)
+    _mark(cell, "h2d")
     t0 = time.perf_counter()
     try:
+        faults.fire("h2d")
         dev = h2d(payload)
     except Exception as e:  # see _timed_prep: interrupts pass through
         raise PrepError(
@@ -241,7 +288,141 @@ def _prep_then_h2d(prep: Callable, h2d: Callable, item,
             % (item, traceback.format_exc())) from e
     if timers is not None:
         timers.add("h2d", time.perf_counter() - t0)
+    _mark(cell, "done")
     return dev
+
+
+def _is_fatal(exc: BaseException) -> bool:
+    """True for the chaos harness's simulated hard kill
+    (faults.InjectedFault(fatal=True)), possibly wrapped in PrepError
+    by the worker: never retried, re-raised as-is."""
+    cause = exc.__cause__ if isinstance(exc, PrepError) else exc
+    return isinstance(cause, faults.InjectedFault) and cause.fatal
+
+
+def _await_attempt(wait_tick: Callable, outcome: Callable,
+                   cell: dict, timeout: float, queued_since: float):
+    """Shared wait loop of one guarded prep+h2d attempt. `wait_tick(t)`
+    blocks up to t seconds and returns True once the attempt finished;
+    `outcome()` then returns its value or raises. Enforces `timeout`
+    per STAGE via the worker-updated cell; a task no worker has picked
+    up yet counts its QUEUE wait (since `queued_since`) against the
+    same deadline — with every pool worker wedged on abandoned hangs,
+    the queue itself is the hung stage, and the retry's dedicated
+    thread is what routes around the dead pool. Returns
+    (True, value, None) | (False, exception, stage) |
+    (False, None, stage) — the last meaning a stage deadline expired
+    (the attempt's thread is abandoned)."""
+    while True:
+        if wait_tick(_POLL_S if timeout > 0 else None):
+            try:
+                return True, outcome(), None
+            except BaseException as e:
+                return False, e, cell.get("stage")
+        stage = cell.get("stage", "queued")
+        since = cell.get("since", queued_since)
+        if (timeout > 0 and stage in ("queued", "prep", "h2d")
+                and time.perf_counter() - since > timeout):
+            return False, None, stage
+
+
+def _guarded_prep_h2d(prep: Callable, h2d: Callable, item,
+                      timers: Optional[StageTimers],
+                      first_future=None, first_cell=None):
+    """Resolve one chunk's prep+h2d under the stage guard
+    (GS_STAGE_TIMEOUT_S / GS_STAGE_RETRIES): a per-stage deadline with
+    bounded deterministic-backoff retry. Attempt 1 consumes
+    `first_future` (already submitted to the pool) when given; retry
+    attempts run on DEDICATED daemon threads so a hung pool worker is
+    abandoned rather than re-poisoned. Prep and h2d are safe to re-run
+    by contract (prep is pure, h2d an idempotent transfer).
+
+    This is the cell-aware twin of resilience.call_guarded (which
+    deadlines a whole call): the per-STAGE deadline and the
+    pooled-first-attempt handoff need the worker-updated cell, which
+    the generic guard has no notion of. A change to retry semantics
+    (fatal pass-through, attempt accounting, backoff) must land in
+    BOTH."""
+    retries = resilience.stage_retries()
+    timeout = resilience.stage_timeout_s()
+    backoff = resilience.stage_backoff_s()
+    attempts: List[dict] = []
+    last_stage = "prep"
+    for attempt in range(retries + 1):
+        t0 = time.perf_counter()
+        if attempt == 0 and first_future is not None:
+            cell = first_cell if first_cell is not None else {}
+            ok, res, stage = _await_attempt(
+                lambda t: _future_wait(first_future, t),
+                first_future.result, cell, timeout,
+                cell.get("submitted", t0))
+        elif timeout > 0:
+            cell, box, done = {}, {}, threading.Event()
+
+            def _runner(cell=cell, box=box, done=done):
+                try:
+                    box["value"] = _prep_then_h2d(prep, h2d, item,
+                                                  timers, cell)
+                except BaseException as e:
+                    box["error"] = e
+                finally:
+                    done.set()
+
+            threading.Thread(target=_runner, daemon=True,
+                             name="gs-ingress-retry").start()
+
+            def _outcome(box=box):
+                if "error" in box:
+                    raise box["error"]
+                return box["value"]
+
+            ok, res, stage = _await_attempt(done.wait, _outcome, cell,
+                                            timeout, t0)
+        else:  # retries without a deadline: run inline
+            cell = {}
+            try:
+                return _prep_then_h2d(prep, h2d, item, timers, cell)
+            except Exception as e:
+                ok, res, stage = False, e, cell.get("stage")
+        if ok:
+            return res
+        if res is not None and (not isinstance(res, Exception)
+                                or _is_fatal(res)):
+            raise res  # interrupts and the simulated kill: unretried
+        last_stage = stage or last_stage
+        attempts.append({
+            "stage": last_stage,
+            "outcome": "timeout" if res is None else type(res).__name__,
+            "elapsed_s": round(time.perf_counter() - t0, 6)})
+        if attempt >= retries:
+            if res is None:
+                raise StageTimeout(
+                    "%s stage of chunk %r exceeded its %.3gs deadline "
+                    "(GS_STAGE_TIMEOUT_S) on %d attempt(s)"
+                    % (last_stage, item, timeout, len(attempts)),
+                    last_stage, item, attempts)
+            raise StageFailed(
+                "%s stage of chunk %r failed after %d attempt(s): %s"
+                % (last_stage, item, len(attempts), res),
+                last_stage, item, attempts) from res
+        time.sleep(backoff * (2 ** attempt))
+
+
+def _future_wait(fut, t: Optional[float]) -> bool:
+    """Event.wait-shaped adapter over Future: True once done."""
+    if t is None:
+        try:
+            fut.exception()  # blocks to completion; outcome re-raises
+        except BaseException:
+            pass
+        return True
+    try:
+        fut.exception(timeout=t)
+    except _FutureTimeout:
+        return fut.done()
+    except BaseException:
+        pass
+    return True
 
 
 def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
@@ -266,58 +447,113 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
                         at the end)
 
     A prep/h2d failure surfaces in the caller as PrepError
-    (RuntimeError) carrying the worker traceback; pending futures are
-    cancelled. With pipelining disabled (`forced_sync`,
-    GS_STREAM_PREFETCH=0, or zero workers) both stages run inline —
-    identical results either way.
+    (RuntimeError) carrying the worker traceback — or, with the stage
+    guard armed (GS_STAGE_TIMEOUT_S / GS_STAGE_RETRIES), as a typed
+    StageTimeout/StageFailed naming the chunk and stage once the
+    attempt budget is exhausted. Pending futures are cancelled, and the
+    already-dispatched previous chunk is DRAINED (its finalize runs
+    best-effort) before any error re-raises, so device buffers and the
+    d2h in flight are never silently abandoned. With pipelining
+    disabled (`forced_sync`, GS_STREAM_PREFETCH=0, or zero workers)
+    both stages run inline — identical results either way.
     """
     items = list(items)
     pool = prep_pool() if len(items) > 1 else None
-    pending_raw = None
+    pending = None  # (item, raw outputs) one chunk behind dispatch
+    guard = resilience.guard_active()
+    futures = ()
 
-    def _finalize(raw):
+    def _finalize(item, raw):
         t0 = time.perf_counter()
-        finalize(raw)
+
+        def _call():
+            faults.fire("finalize")
+            finalize(raw)
+
+        if guard:
+            # deadline only, NEVER retried: finalize mutates consumer
+            # state (appends results, advances carried mirrors), so a
+            # re-run would double-apply; a hang still surfaces as a
+            # typed StageTimeout instead of stalling the stream
+            resilience.call_guarded("finalize", item, _call, retries=0)
+        else:
+            _call()
         if timers is not None:
             timers.add("compute", time.perf_counter() - t0)
             timers.chunks += 1
 
-    def _consume(dev):
-        nonlocal pending_raw
-        raw = dispatch(dev)
-        if pending_raw is not None:
-            _finalize(pending_raw)
-        pending_raw = raw
+    def _consume(item, dev):
+        nonlocal pending
 
-    if pool is None:
-        for item in items:
-            _consume(_prep_then_h2d(prep, h2d, item, timers))
-    else:
-        from collections import deque
+        def _call():
+            faults.fire("dispatch")
+            return dispatch(dev)
 
-        # bounded look-ahead caps host memory AND in-flight device
-        # buffers at inflight_limit() prepped+transferred chunks
-        # (default 3) — the footprint bound of the old depth-2 queue,
-        # independent of the pool width
-        lookahead = min(len(items), worker_count() + 1,
-                        inflight_limit())
-        futures = deque(
-            pool.submit(_prep_then_h2d, prep, h2d, it, timers)
-            for it in items[:lookahead])
-        nxt = lookahead
-        try:
+        # dispatch is retries=0 too: engines fold the chunk into a
+        # device-resident carry inside it, so re-running would
+        # double-fold the chunk
+        raw = (resilience.call_guarded("dispatch", item, _call,
+                                       retries=0)
+               if guard else _call())
+        if pending is not None:
+            done_chunk, pending = pending, None
+            _finalize(*done_chunk)
+        pending = (item, raw)
+
+    def _submit(it):
+        # `submitted` anchors the queue-wait deadline: a task no
+        # wedged-pool worker ever picks up must still time out
+        cell = {"submitted": time.perf_counter()}
+        return (it, cell,
+                pool.submit(_prep_then_h2d, prep, h2d, it, timers,
+                            cell))
+
+    try:
+        if pool is None:
+            for item in items:
+                dev = (_guarded_prep_h2d(prep, h2d, item, timers)
+                       if guard
+                       else _prep_then_h2d(prep, h2d, item, timers))
+                _consume(item, dev)
+        else:
+            from collections import deque
+
+            # bounded look-ahead caps host memory AND in-flight device
+            # buffers at inflight_limit() prepped+transferred chunks
+            # (default 3) — the footprint bound of the old depth-2
+            # queue, independent of the pool width
+            lookahead = min(len(items), worker_count() + 1,
+                            inflight_limit())
+            futures = deque(_submit(it) for it in items[:lookahead])
+            nxt = lookahead
             while futures:
-                dev = futures.popleft().result()
+                item, cell, fut = futures.popleft()
+                dev = (_guarded_prep_h2d(prep, h2d, item, timers,
+                                         first_future=fut,
+                                         first_cell=cell)
+                       if guard else fut.result())
                 if nxt < len(items):
-                    futures.append(pool.submit(
-                        _prep_then_h2d, prep, h2d, items[nxt], timers))
+                    futures.append(_submit(items[nxt]))
                     nxt += 1
-                _consume(dev)
-        finally:
-            for f in futures:
-                f.cancel()
-    if pending_raw is not None:
-        _finalize(pending_raw)
+                _consume(item, dev)
+    except Exception:
+        # drain in-flight device work before surfacing the failure:
+        # the previous chunk was already dispatched, so its outputs
+        # (device buffers + the pending d2h) are materialized
+        # best-effort instead of abandoned (a hung drain is bounded by
+        # the same finalize deadline when the guard is armed)
+        if pending is not None:
+            done_chunk, pending = pending, None
+            try:
+                _finalize(*done_chunk)
+            except Exception:
+                pass
+        raise
+    finally:
+        for _it, _cell, f in futures:
+            f.cancel()
+    if pending is not None:
+        _finalize(*pending)
 
 
 def submit_prep(fn: Callable, item, timers: Optional[StageTimers] = None):
@@ -339,14 +575,42 @@ def map_ordered(fn: Callable, items: Iterable) -> List:
     first-occurrence uniques for interning). Results are returned in
     item order regardless of worker scheduling, and the sequential
     form runs when pipelining is disabled, so outputs are identical at
-    every pool size (the worker-pool determinism contract)."""
+    every pool size (the worker-pool determinism contract).
+
+    Honors the stage guard like every other prep consumer: with
+    GS_STAGE_RETRIES/GS_STAGE_TIMEOUT_S armed, a failed or hung pooled
+    item is re-run under resilience.call_guarded (fn is pure by the
+    prep contract); inert knobs keep the legacy zero-overhead path."""
     items = list(items)
     pool = prep_pool() if len(items) > 1 else None
+    guard = resilience.guard_active()
+
+    def _rerun(i, it):
+        return resilience.call_guarded(
+            "prep", i, lambda: _timed_prep(fn, it, None))
+
     if pool is None:
-        return [_timed_prep(fn, it, None) for it in items]
+        if not guard:
+            return [_timed_prep(fn, it, None) for it in items]
+        return [_rerun(i, it) for i, it in enumerate(items)]
     futures = [pool.submit(_timed_prep, fn, it, None) for it in items]
     try:
-        return [f.result() for f in futures]
+        if not guard:
+            return [f.result() for f in futures]
+        out = []
+        timeout = resilience.stage_timeout_s()
+        for i, (it, fut) in enumerate(zip(items, futures)):
+            try:
+                out.append(fut.result(
+                    timeout=2 * timeout if timeout > 0 else None))
+            except BaseException as e:
+                if not isinstance(e, Exception) or _is_fatal(e):
+                    raise  # interrupts / the simulated kill
+                # pooled attempt failed (or its 2×deadline wait
+                # expired — the worker is abandoned): re-run under the
+                # guard's own watchdog/retry budget
+                out.append(_rerun(i, it))
+        return out
     finally:
         for f in futures:
             f.cancel()

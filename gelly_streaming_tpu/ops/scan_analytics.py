@@ -42,6 +42,7 @@ from . import ingress_pipeline
 from . import segment as seg_ops
 from . import triangles as tri_ops
 from . import unionfind
+from ..utils import checkpoint
 
 
 def _build_scan(eb: int, vb: int, kb: int):
@@ -99,11 +100,21 @@ class SummaryEngineBase:
 
     def reset(self) -> None:
         self._closed_partial = False
+        self.windows_done = 0  # resume cursor (checkpoint/resume)
         if not hasattr(self, "stage_timers"):
             # per-stage pipeline counters (ops/ingress_pipeline);
             # survive reset() so a timed run's snapshot is cumulative
             # until explicitly .reset()
             self.stage_timers = ingress_pipeline.StageTimers()
+        if not hasattr(self, "_ckpt_path"):
+            # auto-checkpoint config survives reset() like the timers
+            self._ckpt_path = None
+            self._ckpt_policy = None
+        elif self._ckpt_policy is not None:
+            # re-anchor the cadence with the rewound cursor: a stale
+            # high-water mark would suppress every due() until the new
+            # stream re-passed it (same fix as the driver's reset)
+            self._ckpt_policy.mark(0)
         self._carry = (
             jnp.zeros(self.vb + 1, jnp.int32),
             jnp.arange(self.vb + 1, dtype=jnp.int32),
@@ -115,6 +126,84 @@ class SummaryEngineBase:
         deg, labels, cover = (np.asarray(x) for x in self._carry)
         odd = cover[: self.vb] == cover[self.vb + 1: 2 * self.vb + 1]
         return deg[: self.vb], labels[: self.vb], odd
+
+    # ------------------------------------------------------------------
+    # checkpoint / resume (utils/checkpoint.py)
+    # ------------------------------------------------------------------
+    def state_dict(self) -> dict:
+        """Full resumable state: the three carried vectors (d2h'd to
+        host arrays) plus the windows_done cursor. The layout is the
+        carry's own, shared by the single-chip and sharded engines, so
+        checkpoints are engine-interchangeable at equal buckets."""
+        deg, labels, cover = (np.array(x) for x in self._carry)
+        return {
+            "edge_bucket": self.eb,
+            "vertex_bucket": self.vb,
+            "windows_done": int(self.windows_done),
+            "closed_partial": bool(self._closed_partial),
+            "carry": (deg, labels, cover),
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state["edge_bucket"] != self.eb \
+                or state["vertex_bucket"] != self.vb:
+            raise ValueError(
+                "bucket mismatch: checkpoint was taken at eb=%d vb=%d, "
+                "engine runs eb=%d vb=%d — count-based windows are cut "
+                "by eb, so resuming across buckets would shift every "
+                "window boundary" % (state["edge_bucket"],
+                                     state["vertex_bucket"],
+                                     self.eb, self.vb))
+        self.windows_done = int(state["windows_done"])
+        self._closed_partial = bool(state["closed_partial"])
+        self._carry = tuple(jnp.asarray(a) for a in state["carry"])
+
+    def enable_auto_checkpoint(self, path: str,
+                               every_n_windows: int = 16,
+                               every_seconds: float = 0.0,
+                               policy=None) -> None:
+        """Auto-snapshot on a CheckpointPolicy cadence, evaluated at
+        chunk DISPATCH boundaries (where the device carry exactly
+        covers the finalized-or-dispatched prefix) and flushed to disk
+        only once every covered window's summary has been handed to
+        the caller — the driver's staged at-least-once contract."""
+        if policy is None:
+            policy = checkpoint.CheckpointPolicy(
+                every_n_windows=max(0, every_n_windows),
+                every_seconds=every_seconds)
+        if not policy.enabled():
+            raise ValueError("checkpoint policy has no trigger enabled")
+        self._ckpt_path = path
+        self._ckpt_policy = policy
+
+    def try_resume(self, path: str) -> bool:
+        """Restore from the newest intact checkpoint generation
+        (rotation fallback on corruption — utils/checkpoint.
+        load_latest); False when nothing usable exists. After a True
+        return, feed the stream from `resume_offset()` edges in."""
+        import warnings
+
+        try:
+            got = checkpoint.load_latest(path)
+        except checkpoint.CheckpointCorrupt as e:
+            warnings.warn(f"{e}; no intact generation — starting fresh")
+            return False
+        if got is None:
+            return False
+        state, used = got
+        if used != path:
+            warnings.warn(
+                f"checkpoint {path!r} is corrupt; resumed from the "
+                f"rotated previous generation {used!r}")
+        self.load_state_dict(state)
+        return True
+
+    def resume_offset(self) -> int:
+        """Edges already folded into the carried state: a resumed
+        caller feeds `src[offset:], dst[offset:]` and gets exactly the
+        uninterrupted run's remaining summaries (the windows_done
+        cursor — windows are count-based eb-sized)."""
+        return self.windows_done * self.eb
 
     def _h2d(self, args):
         """Transfer one chunk's prepped host stacks to device arrays
@@ -183,6 +272,8 @@ class SummaryEngineBase:
             num_w, s, d, valid = seg_ops.window_stack(
                 src, dst, self.eb, sentinel=self.vb)
         out = []
+        base = self.windows_done
+        staged = []  # checkpoint snapshots due mid-call (see below)
 
         # the shared three-stage ingress pipeline
         # (ops/ingress_pipeline): chunk prep runs on the worker pool,
@@ -211,6 +302,22 @@ class SummaryEngineBase:
 
         def dispatch(dev_payload):
             at, real, dev = dev_payload
+            if (self._ckpt_path is not None and at
+                    and self._ckpt_policy.due(base + at)):
+                # the device carry at a chunk-DISPATCH boundary covers
+                # exactly the `base + at` windows dispatched so far —
+                # the one point where a bit-exact window-boundary
+                # snapshot costs a single d2h sync. The snapshot is
+                # STAGED and written only on clean process() return
+                # (the call is the delivery unit: a crash mid-call
+                # hands the caller nothing, so a flushed checkpoint
+                # covering this call's windows would make resume skip
+                # summaries never delivered — at-most-once).
+                self._ckpt_policy.mark(base + at)
+                snap = self.state_dict()
+                snap["windows_done"] = base + at
+                snap["closed_partial"] = False  # never mid-call
+                staged.append(snap)
             raw = (self._dispatch_async_compact(*dev) if compact
                    else self._dispatch_async(*dev))
             return at, real, raw
@@ -231,10 +338,20 @@ class SummaryEngineBase:
                     "odd_cycle": bool(odd[w]),
                     "triangles": int(tri[w]),
                 })
+            self.windows_done += f_real
 
         ingress_pipeline.run_pipeline(
             range(0, num_w, self.MAX_WINDOWS),
             prep, h2d, dispatch, finalize, timers=self.stage_timers)
+        if self._ckpt_path is not None:
+            if self._ckpt_policy.due(self.windows_done):
+                self._ckpt_policy.mark(self.windows_done)
+                staged.append(self.state_dict())
+            # clean completion: deliver, then persist. Only the last
+            # two snapshots can survive save's rotation anyway, so the
+            # rest would be pure wasted compression + I/O.
+            for snap in staged[-2:]:
+                checkpoint.save(self._ckpt_path, snap)
         return out
 
 

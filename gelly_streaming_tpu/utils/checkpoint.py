@@ -10,17 +10,49 @@ the recovery story the reference's combine-fn javadoc alludes to
 
 Storage: a single .npz for array leaves + a JSON sidecar-free encoding
 of the tree structure (object leaves go through repr-safe lists).
+
+Durability contract (the failure-recovery runtime leans on all three):
+- `save` is atomic (tmp + rename; the tmp name is process-unique and
+  unlinked on ANY failure) and ROTATES: the previous checkpoint
+  survives one generation as `path + ".prev"`, so external damage to
+  the newest file never strands a resumable job.
+- `restore` of a truncated/corrupt file raises a typed
+  `CheckpointCorrupt` carrying the path — callers distinguish damage
+  (fall back to the previous generation, or start fresh) from
+  operational failures (permissions, EIO), which still raise raw.
+- `load_latest` is the resume-side pairing: newest generation first,
+  rotation fallback on corruption, `None` when nothing usable exists.
+
+`CheckpointPolicy` is the shared cadence object (every N windows
+and/or every T seconds, injectable clock for deterministic tests) the
+driver and the fused summary engines consult at their window/chunk
+boundaries.
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
-from typing import Any, Dict
+import time
+from typing import Any, Callable, Dict, Optional
 
 import numpy as np
 
+from . import faults
+
 _ARRAY_KEY = "__arrays__"
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A checkpoint file exists but cannot be decoded (truncation,
+    bit-flips, torn external writes). `path` names the damaged file."""
+
+    def __init__(self, path: str, cause: BaseException):
+        super().__init__(
+            f"checkpoint {path!r} is corrupt "
+            f"({type(cause).__name__}: {cause})")
+        self.path = path
 
 
 def _key(k):
@@ -74,20 +106,130 @@ def _unflatten(node: dict, arrays) -> Any:
     raise TypeError(kind)
 
 
+def prev_path(path: str) -> str:
+    """The rotated previous-generation file `save` keeps beside
+    `path` (last-2 retention)."""
+    return path + ".prev"
+
+
 def save(path: str, tree: Any) -> None:
+    """Atomically write `tree` to `path`, rotating the existing file
+    to `prev_path(path)` first. The tmp name carries the pid so two
+    writers (e.g. a live job and an operator-driven manual snapshot)
+    can never clobber each other's in-progress tmp; the tmp is
+    unlinked on any failure instead of leaking beside the
+    checkpoint."""
     arrays: Dict[str, np.ndarray] = {}
     spec = _flatten(tree, arrays)
     arrays[_ARRAY_KEY + "spec"] = np.frombuffer(
         json.dumps(spec).encode(), dtype=np.uint8
     )
-    tmp = path + ".tmp"
-    np.savez_compressed(tmp, **arrays)
     # np.savez appends .npz to the filename it is given
-    os.replace(tmp + ".npz" if not tmp.endswith(".npz") else tmp, path)
+    tmp = "%s.tmp.%d" % (path, os.getpid())
+    written = tmp + ".npz"
+    try:
+        np.savez_compressed(tmp, **arrays)
+        if os.path.exists(path):
+            # one-generation rotation: between this replace and the
+            # next, `path` is momentarily absent — restore-side
+            # fallback (load_latest) covers a crash in that window
+            os.replace(path, prev_path(path))
+        os.replace(written, path)
+    finally:
+        if os.path.exists(written):
+            try:
+                os.unlink(written)
+            except OSError:
+                pass
+    # external-damage injection point for the fault suite: fires AFTER
+    # the atomic replace, modelling damage to a completed checkpoint
+    faults.fire("ckpt_save", path)
 
 
 def restore(path: str) -> Any:
-    with np.load(path, allow_pickle=False) as data:
-        spec = json.loads(bytes(data[_ARRAY_KEY + "spec"]).decode())
-        arrays = {k: data[k] for k in data.files if k != _ARRAY_KEY + "spec"}
-    return _unflatten(spec, arrays)
+    """Decode one checkpoint file. Damage (truncation, bit-flipped
+    deflate streams, mangled payloads) raises CheckpointCorrupt;
+    operational failures (missing file, permissions, EIO) raise their
+    raw OSError so callers never silently reprocess a fixable
+    problem."""
+    import zipfile
+    import zlib
+
+    faults.fire("ckpt_restore", path)
+    try:
+        with np.load(path, allow_pickle=False) as data:
+            spec = json.loads(bytes(data[_ARRAY_KEY + "spec"]).decode())
+            arrays = {k: data[k] for k in data.files
+                      if k != _ARRAY_KEY + "spec"}
+        return _unflatten(spec, arrays)
+    except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
+            EOFError, json.JSONDecodeError, TypeError,
+            IndexError) as e:
+        # the failure shapes np.load / the spec decode produce for
+        # damaged archives: truncation -> BadZipFile/EOFError,
+        # bit-flipped deflate -> zlib.error, mangled payloads ->
+        # ValueError/KeyError/TypeError/IndexError/JSONDecodeError
+        raise CheckpointCorrupt(path, e) from e
+
+
+def load_latest(path: str):
+    """Resume-side restore with rotation fallback: try `path`, then
+    `prev_path(path)` when the newest generation is corrupt or absent.
+    Returns (tree, used_path), or None when no generation exists;
+    raises CheckpointCorrupt only when every existing generation is
+    damaged."""
+    corrupt = None
+    for cand in (path, prev_path(path)):
+        if not os.path.exists(cand):
+            continue
+        try:
+            return restore(cand), cand
+        except CheckpointCorrupt as e:
+            corrupt = e
+    if corrupt is not None:
+        raise corrupt
+    return None
+
+
+@dataclasses.dataclass
+class CheckpointPolicy:
+    """When to snapshot: every `every_n_windows` processed windows
+    and/or every `every_seconds` of wall time, whichever comes first
+    (either 0 disables that trigger). Consumers ask `due(windows_done)`
+    at their window/chunk boundaries and `mark(windows_done)` after
+    staging a snapshot. `clock` is injectable so the time trigger is
+    deterministic under test (and in tools/chaos_run.py)."""
+
+    every_n_windows: int = 0
+    every_seconds: float = 0.0
+    clock: Callable[[], float] = time.monotonic
+
+    def __post_init__(self):
+        if self.every_n_windows < 0 or self.every_seconds < 0:
+            raise ValueError("checkpoint cadences must be >= 0")
+        self._last_w = 0
+        self._last_t: Optional[float] = None
+
+    def enabled(self) -> bool:
+        return self.every_n_windows > 0 or self.every_seconds > 0
+
+    def due(self, windows_done: int) -> bool:
+        if self.every_n_windows > 0 and (
+                windows_done // self.every_n_windows
+                > self._last_w // self.every_n_windows):
+            return True
+        if self.every_seconds > 0:
+            now = self.clock()
+            if self._last_t is None:
+                # the first due() anchors the time base: a job that
+                # dies before its first interval elapses simply
+                # restarts from the stream head
+                self._last_t = now
+            elif now - self._last_t >= self.every_seconds:
+                return True
+        return False
+
+    def mark(self, windows_done: int) -> None:
+        self._last_w = windows_done
+        if self.every_seconds > 0:
+            self._last_t = self.clock()

@@ -1,0 +1,251 @@
+"""Stage watchdogs, bounded retry, and the tier-demotion registry.
+
+The round-5 queue log's failure mode ("tunnel never answered") is a
+HANG, not an exception: an h2d or dispatch through a tunneled chip that
+never returns stalls the whole stream forever, because nothing in the
+ingress pipeline owned a deadline. This module is the shared guard
+machinery:
+
+- Typed stage errors. `StageTimeout` / `StageFailed` carry which chunk,
+  which stage, and the per-attempt timings, so an operator (or
+  tools/chaos_run.py) can tell a wedged transfer from a poisoned prep
+  without parsing tracebacks.
+- `call_guarded` — run one stage under a configurable deadline
+  (`GS_STAGE_TIMEOUT_S`) with bounded retry and DETERMINISTIC
+  (jitterless) exponential backoff (`GS_STAGE_RETRIES`,
+  `GS_STAGE_BACKOFF_S`). With both knobs at their defaults (0) the
+  guard is inert and callers run their legacy inline path — zero
+  threads, zero overhead, bit-identical behavior.
+- The demotion registry — a process-global log of tier demotions
+  (device→native→host) the driver records and
+  tools/profile_kernels.py commits to PERF.json as a `degradations`
+  section, so a degraded run is visibly labeled and can never
+  masquerade as a device-tier measurement.
+
+Deadline mechanics: the guarded callable runs on a helper thread and
+the caller waits `timeout` seconds. On expiry the helper is ABANDONED
+(daemon; Python cannot safely interrupt a thread blocked in a ctypes
+or network call — exactly the hung-tunnel shape) and the attempt is
+retried or surfaced as `StageTimeout`. A guarded stage must therefore
+be safe to re-run: prep is pure and h2d is an idempotent transfer;
+side-effecting stages (finalize, carry-mutating dispatch) are guarded
+with `retries=0` — deadline only — by their callers.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from . import faults
+
+
+class StageError(RuntimeError):
+    """Base of the typed stage failures. `stage` is the pipeline stage
+    name ('prep' / 'h2d' / 'dispatch' / 'finalize'), `chunk` the chunk
+    descriptor the caller passed, `attempts` one dict per attempt:
+    {"outcome": "timeout" | exception class name, "elapsed_s": float}.
+    """
+
+    def __init__(self, message: str, stage: str, chunk,
+                 attempts: Optional[List[dict]] = None):
+        super().__init__(message)
+        self.stage = stage
+        self.chunk = chunk
+        self.attempts = attempts or []
+
+
+class StageTimeout(StageError):
+    """A stage exceeded its GS_STAGE_TIMEOUT_S deadline on every
+    allowed attempt (the hung-tunnel shape)."""
+
+
+class StageFailed(StageError):
+    """A stage raised on every allowed attempt; the last exception
+    rides as __cause__."""
+
+
+# ----------------------------------------------------------------------
+# env knobs (read per call: tests and tools/chaos_run.py flip them
+# mid-process; parsing two ints per guarded chunk is noise)
+# ----------------------------------------------------------------------
+def stage_timeout_s() -> float:
+    """Per-stage watchdog deadline in seconds (GS_STAGE_TIMEOUT_S);
+    0 (default) disables the watchdog entirely."""
+    try:
+        return max(0.0, float(os.environ.get("GS_STAGE_TIMEOUT_S", "0")))
+    except ValueError:
+        return 0.0
+
+
+def stage_retries() -> int:
+    """Extra attempts after the first failure/timeout
+    (GS_STAGE_RETRIES, default 0 = fail on first error)."""
+    try:
+        return max(0, int(os.environ.get("GS_STAGE_RETRIES", "0")))
+    except ValueError:
+        return 0
+
+
+def stage_backoff_s() -> float:
+    """Base of the deterministic exponential backoff between retry
+    attempts: sleep base·2^attempt, NO jitter (GS_STAGE_BACKOFF_S,
+    default 0.05). Jitter exists to de-correlate fleets; a single
+    streaming process gains nothing from it and loses reproducibility.
+    """
+    try:
+        return max(0.0, float(os.environ.get("GS_STAGE_BACKOFF_S",
+                                             "0.05")))
+    except ValueError:
+        return 0.05
+
+
+def guard_active() -> bool:
+    """True when either knob arms the guard; callers keep their legacy
+    inline path (and exact legacy exception types) otherwise."""
+    return stage_timeout_s() > 0 or stage_retries() > 0
+
+
+_TIMEOUT = object()  # sentinel: deadline expired
+
+
+def _run_with_deadline(fn: Callable, timeout: float):
+    """Run fn() on a daemon helper thread, waiting at most `timeout`
+    seconds. Returns fn's value, re-raises its exception, or returns
+    the _TIMEOUT sentinel (the helper is abandoned — see module
+    docstring)."""
+    box = {}
+    done = threading.Event()
+
+    def runner():
+        try:
+            box["value"] = fn()
+        except BaseException as e:
+            box["error"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=runner, daemon=True,
+                         name="gs-stage-watchdog")
+    t.start()
+    if not done.wait(timeout):
+        return _TIMEOUT
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def call_guarded(stage: str, chunk, fn: Callable, *,
+                 retries: Optional[int] = None,
+                 timeout: Optional[float] = None):
+    """Run `fn()` (one stage of one chunk) under the watchdog/retry
+    policy. retries/timeout default to the env knobs; pass retries=0
+    for side-effecting stages that must not re-run.
+
+    Raises StageTimeout/StageFailed with per-attempt timings once the
+    attempt budget is exhausted. KeyboardInterrupt/SystemExit and
+    FATAL injected faults (faults.InjectedFault(fatal=True) — the
+    chaos harness's simulated kill) pass through unwrapped and
+    unretried."""
+    if retries is None:
+        retries = stage_retries()
+    if timeout is None:
+        timeout = stage_timeout_s()
+    backoff = stage_backoff_s()
+    attempts: List[dict] = []
+    for attempt in range(retries + 1):
+        t0 = time.perf_counter()
+        try:
+            if timeout > 0:
+                out = _run_with_deadline(fn, timeout)
+            else:
+                out = fn()
+        except faults.InjectedFault as e:
+            if e.fatal:
+                raise  # the simulated hard kill: never retried
+            attempts.append({"outcome": type(e).__name__,
+                             "elapsed_s": time.perf_counter() - t0})
+            if attempt >= retries:
+                raise StageFailed(
+                    "%s stage failed for chunk %r after %d attempt(s): %s"
+                    % (stage, chunk, len(attempts), e),
+                    stage, chunk, attempts) from e
+        except Exception as e:
+            attempts.append({"outcome": type(e).__name__,
+                             "elapsed_s": time.perf_counter() - t0})
+            if attempt >= retries:
+                raise StageFailed(
+                    "%s stage failed for chunk %r after %d attempt(s): %s"
+                    % (stage, chunk, len(attempts), e),
+                    stage, chunk, attempts) from e
+        else:
+            if out is not _TIMEOUT:
+                return out
+            attempts.append({"outcome": "timeout",
+                             "elapsed_s": time.perf_counter() - t0})
+            if attempt >= retries:
+                raise StageTimeout(
+                    "%s stage of chunk %r exceeded its %.3gs deadline "
+                    "on %d attempt(s) (GS_STAGE_TIMEOUT_S; per-attempt "
+                    "timings on .attempts)"
+                    % (stage, chunk, timeout, len(attempts)),
+                    stage, chunk, attempts)
+        time.sleep(backoff * (2 ** attempt))
+
+
+# ----------------------------------------------------------------------
+# tier-demotion registry
+# ----------------------------------------------------------------------
+_DEMOTIONS: List[dict] = []
+_DEMOTIONS_LOCK = threading.Lock()
+
+
+def record_demotion(component: str, from_tier: str, to_tier: str,
+                    window: int, reason: str) -> dict:
+    """Log one tier demotion (or a failed re-promotion probe). The
+    process-global log is what tools/profile_kernels.py snapshots into
+    PERF.json's `degradations` section, so a run that silently fell
+    off the device tier is labeled in the committed evidence."""
+    event = {
+        "component": component,
+        "from": from_tier,
+        "to": to_tier,
+        "window": int(window),
+        "reason": reason[:500],
+    }
+    with _DEMOTIONS_LOCK:
+        _DEMOTIONS.append(event)
+    return event
+
+
+def demotion_events() -> List[dict]:
+    with _DEMOTIONS_LOCK:
+        return list(_DEMOTIONS)
+
+
+def reset_demotions() -> None:
+    """Test/tool hook: clear the process-global demotion log."""
+    with _DEMOTIONS_LOCK:
+        _DEMOTIONS.clear()
+
+
+def tier_retry_windows() -> int:
+    """Probation length for re-promotion after a tier demotion
+    (GS_TIER_RETRY_WINDOWS): after this many windows finalized on the
+    demoted tier without failure, the driver retries the higher tier
+    once; a repeat failure demotes again (and restarts probation).
+    0 (default) = a demotion is permanent for the process."""
+    try:
+        return max(0, int(os.environ.get("GS_TIER_RETRY_WINDOWS", "0")))
+    except ValueError:
+        return 0
+
+
+def tier_demotion_enabled() -> bool:
+    """GS_TIER_DEMOTE=0 pins the resolved tier: failures raise instead
+    of degrading — what a measurement harness wants (a silently
+    demoted bench row is worse than a failed one; the profiler also
+    labels any demotion that does happen)."""
+    return os.environ.get("GS_TIER_DEMOTE", "1") != "0"

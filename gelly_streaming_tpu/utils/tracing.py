@@ -22,6 +22,17 @@ class StepTimer:
         self.totals: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
         self.records: Dict[str, int] = defaultdict(int)
+        self.events: List[dict] = []  # discrete happenings (demotions)
+
+    def event(self, name: str, info: dict = None) -> None:
+        """Record a discrete runtime event (e.g. a tier demotion) into
+        the trace: not a timing, a happening — surfaced by
+        `event_log()` beside `report()` so a degraded run's trace says
+        so explicitly."""
+        self.events.append({"event": name, **(info or {})})
+
+    def event_log(self) -> List[dict]:
+        return list(self.events)
 
     def add(self, name: str, seconds: float, num_records: int = 0) -> None:
         """Record one already-measured step (used by the runtime's

@@ -22,6 +22,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running leg (kept in-suite; the mark "
         "documents the cost and allows -m 'not slow' deselection)")
+    config.addinivalue_line(
+        "markers", "faults: deterministic fault-injection suite "
+        "(utils/faults) — CPU-only, no randomness, real sleeps bounded "
+        "by ~100ms-scale watchdog deadlines; runs in tier-1 (it is "
+        "deliberately NOT 'slow')")
 
 
 @pytest.fixture

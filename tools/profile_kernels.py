@@ -1277,9 +1277,20 @@ def run_section_child(name: str) -> None:
     orchestrator instead of vanishing with the child."""
     import jax
 
+    from gelly_streaming_tpu.utils import resilience
+
     results = {"backend": jax.default_backend(),
                "device": str(jax.devices()[0])}
     SECTIONS[name](results)
+    # tier demotions during the section (core/driver._maybe_demote →
+    # utils/resilience registry): a run that silently fell off the
+    # device tier mid-measurement must be LABELED — the orchestrator
+    # accumulates these into PERF.json's `degradations` section, so a
+    # demoted chip run can never masquerade as a device-tier row
+    events = resilience.demotion_events()
+    if events:
+        results["degradations"] = [dict(e, section=name)
+                                   for e in events]
     print(json.dumps(results), flush=True)
 
 
@@ -1417,6 +1428,13 @@ def main():
                             "on %s" % (results["backend"], child_backend)}
         if got.get("device"):
             results.setdefault("device", got["device"])
+        # a child that demoted tiers mid-measurement reports it even
+        # when its section row also landed: accumulate across sections
+        # (the `degradations` key in PERF.json is the honesty label —
+        # update_perf_md/consumers can flag the affected rows)
+        if got.get("degradations"):
+            results.setdefault("degradations", []).extend(
+                got["degradations"])
         results[name] = got.get(name, got if "error" in got else
                                 {"error": "missing section key"})
         if "error" not in results[name]:
@@ -1424,7 +1442,7 @@ def main():
             # auxiliary keys a section recorded beside its own (e.g.
             # ingress_ab's `ingress_probes`) ride along into PERF.json
             for k, v in got.items():
-                if k not in ("backend", "device", name) \
+                if k not in ("backend", "device", name, "degradations") \
                         and k not in SECTIONS:
                     results[k] = v
         print(json.dumps({name: results[name]}), flush=True)
