@@ -476,6 +476,28 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
         row["device_path_edges_per_s"] = round(device_path_rate)
         row["device_path_vs_baseline"] = round(
             device_path_rate / cpu_rate, 2)
+    # chosen-knob provenance: every row says what dispatch
+    # configuration it actually ran — the static gates, and (when the
+    # online tuner was live on the device path) the tuner's chosen arm
+    # plus its decision timeline tail (ops/autotune.py)
+    from gelly_streaming_tpu.ops import autotune as _autotune
+
+    row["knobs"] = {"k_bucket": kernel.kb,
+                    "windows_per_dispatch": kernel.MAX_STREAM_WINDOWS,
+                    "ingress": kernel.ingress}
+    tuner = getattr(kernel, "tuner", None)
+    if tuner is not None:
+        ts = tuner.summary()
+        row["autotune"] = {
+            "enabled": True,
+            "chosen": ts["chosen"],
+            "rounds": ts["rounds"],
+            "promotions": ts["promotions"],
+            "edges_per_s_ema": ts["edges_per_s_ema"],
+            "timeline": ts["timeline"][-8:],
+        }
+    else:
+        row["autotune"] = {"enabled": _autotune.enabled()}
     print(json.dumps(row), flush=True)
 
 

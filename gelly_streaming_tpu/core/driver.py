@@ -37,6 +37,7 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .. import native
+from ..ops import delta_egress
 from ..ops import host_snapshot
 from ..ops import ingress_pipeline
 from ..ops import segment as seg_ops
@@ -81,7 +82,8 @@ def _frozen_delta(idx: np.ndarray, vals: np.ndarray) -> tuple:
 
 
 def _build_snapshot_scan(vb: int, analytics: tuple,
-                         deltas: bool = False):
+                         deltas: bool = False, egress: str = "full",
+                         cap: int = 0):
     """One jitted lax.scan over a [W, eb] window stack, carrying
     (degrees, cc labels, double-cover labels) and emitting PER-WINDOW
     snapshots — the driver's batched single-chip fast path (sharded
@@ -95,15 +97,25 @@ def _build_snapshot_scan(vb: int, analytics: tuple,
     bool mask over [:vb] (new state vs the scan carry — computed
     on-device, so a consumer of the reference's improving streams
     (SimpleEdgeStream.java:473-481) can reconstruct per-update records
-    from snapshot + mask without diffing full vectors on host)."""
+    from snapshot + mask without diffing full vectors on host).
+
+    With egress="delta" (ops/delta_egress), the per-window output is
+    the COMPACT changed-slot wire instead of full vectors: per analytic
+    an int32 count plus [cap]-sized (indices, new values) rows —
+    2-3 orders of magnitude fewer d2h bytes on settled streams; the
+    driver reconstructs full snapshots from its host mirrors, and a
+    count exceeding `cap` routes the chunk to the bit-exact host fold.
+    The full masks are then NOT emitted (the wire subsumes them)."""
     import jax
     import jax.numpy as jnp
 
+    from ..ops import delta_egress
     from ..ops import unionfind as uf
 
     want_deg = "degrees" in analytics
     want_cc = "cc" in analytics
     want_bip = "bipartite" in analytics
+    delta_out = egress == "delta"
 
     def body(carry, xs):
         deg, labels, cover = carry
@@ -113,16 +125,30 @@ def _build_snapshot_scan(vb: int, analytics: tuple,
         outs = {}
         if want_deg:
             new_deg = deg.at[s].add(1).at[d].add(1)  # slot vb: pads
-            if deltas:
-                outs["deg_chg"] = new_deg[:vb] != deg[:vb]
+            chg = (new_deg[:vb] != deg[:vb]) \
+                if (deltas or delta_out) else None
+            if delta_out:
+                (outs["deg_cnt"], outs["deg_idx"],
+                 outs["deg_val"]) = delta_egress.compact_changed(
+                    chg, new_deg[:vb], cap, 0)
+            else:
+                if deltas:
+                    outs["deg_chg"] = chg
+                outs["deg"] = new_deg
             deg = new_deg
-            outs["deg"] = deg
         if want_cc:
             new_labels = uf.cc_fixpoint(labels, s, d)
-            if deltas:
-                outs["labels_chg"] = new_labels[:vb] != labels[:vb]
+            chg = (new_labels[:vb] != labels[:vb]) \
+                if (deltas or delta_out) else None
+            if delta_out:
+                (outs["labels_cnt"], outs["labels_idx"],
+                 outs["labels_val"]) = delta_egress.compact_changed(
+                    chg, new_labels[:vb], cap, 0)
+            else:
+                if deltas:
+                    outs["labels_chg"] = chg
+                outs["labels"] = new_labels
             labels = new_labels
-            outs["labels"] = labels
         if want_bip:
             sent2 = 2 * vb
             s2 = jnp.concatenate([
@@ -132,14 +158,20 @@ def _build_snapshot_scan(vb: int, analytics: tuple,
                 jnp.where(valid, d + vb, sent2),
                 jnp.where(valid, d, sent2)])
             new_cover = uf.cc_fixpoint(cover, s2, d2)
-            if deltas:
+            if deltas or delta_out:
                 # the consumer-visible value is the odd flag, so the
-                # mask tracks IT, not raw cover labels
-                outs["cover_chg"] = (
-                    (new_cover[:vb] == new_cover[vb:2 * vb])
-                    != (cover[:vb] == cover[vb:2 * vb]))
+                # mask (and the delta wire) tracks IT, not raw labels
+                new_odd = new_cover[:vb] == new_cover[vb:2 * vb]
+                chg = new_odd != (cover[:vb] == cover[vb:2 * vb])
+            if delta_out:
+                (outs["cover_cnt"], outs["cover_idx"],
+                 outs["cover_val"]) = delta_egress.compact_changed(
+                    chg, new_odd, cap, 0)
+            else:
+                if deltas:
+                    outs["cover_chg"] = chg
+                outs["cover"] = new_cover
             cover = new_cover
-            outs["cover"] = cover
         return (deg, labels, cover), outs
 
     @jax.jit
@@ -228,7 +260,8 @@ class StreamingAnalyticsDriver:
                  edge_bucket: int = 1 << 12,
                  mesh=None, tracing: bool = False,
                  emit_deltas: bool = False,
-                 snapshot_tier: str = None):
+                 snapshot_tier: str = None,
+                 egress: str = None):
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
@@ -237,6 +270,12 @@ class StreamingAnalyticsDriver:
         if snapshot_tier == "native" and not native.snapshot_available():
             raise ValueError("native snapshot tier pinned but "
                              "libgsnative lacks gs_snapshot_windows")
+        if egress not in (None, "full", "delta"):
+            raise ValueError(f"unknown egress: {egress!r}")
+        # d2h egress of the batched snapshot scan: explicit pin (tests,
+        # tools/egress_ab.py) or committed-evidence resolution
+        # (ops/delta_egress.resolve_egress); sharded meshes always full
+        self._egress_pin = egress
         self.window_ms = window_ms
         self.analytics = tuple(analytics)
         # batched snapshot analytics tier: explicit pin (tests, the
@@ -270,6 +309,9 @@ class StreamingAnalyticsDriver:
         self._demoted_tier = None
         self._demoted_at = 0      # windows_done when last demoted
         self._demotions = []      # event dicts (also in the registry)
+        # online dispatch tuner of the batched snapshot scan
+        # (ops/autotune; built lazily, None with GS_AUTOTUNE=0)
+        self._scan_tuner = None
 
     def reset(self) -> None:
         """Clear all carried stream state (interner, analytics vectors,
@@ -307,6 +349,18 @@ class StreamingAnalyticsDriver:
         first = self._tri_kernel is None and self._engine is None
         if not (vb_grew or eb_grew or first):
             return
+        if (vb_grew or eb_grew) and self._scan_tuner is not None:
+            # bucket growth changes the per-chunk economics the scan
+            # tuner measured (and its cache identity): re-key it — the
+            # incumbent survives as the prior, stale rates reset, and
+            # the persisted cache re-seeds the new key when this shape
+            # was tuned in an earlier run
+            cap = self._scan_chunk()
+            self._scan_tuner.rekey(
+                self._scan_tuner_key(),
+                space={"wb": sorted({max(1, cap // 4),
+                                     max(1, cap // 2), cap})},
+                initial={"wb": cap})
         if self.mesh is not None:
             from ..parallel.sharded import (ShardedTriangleWindowKernel,
                                             ShardedWindowEngine)
@@ -559,6 +613,57 @@ class StreamingAnalyticsDriver:
         return min(self._SCAN_CHUNK,
                    tri_ops.capped_chunk(self.eb, "snapshot_scan"))
 
+    def _scan_tuner_key(self) -> str:
+        return ("snapshot_scan:eb=%d:vb=%d:%s"
+                % (self.eb, self.vb, "+".join(self.analytics)))
+
+    def _ensure_scan_tuner(self):
+        """The driver's online windows-per-dispatch tuner for the
+        batched snapshot scan (ops/autotune): arms are power-of-two
+        rungs under the compile-capped _scan_chunk(). None when
+        GS_AUTOTUNE=0 — the static stepping then runs bit-identically."""
+        from ..ops import autotune
+
+        if not autotune.enabled():
+            return None
+        if getattr(self, "_scan_tuner", None) is None:
+            cap = self._scan_chunk()
+            wbs = sorted({max(1, cap // 4), max(1, cap // 2), cap})
+            self._scan_tuner = autotune.DispatchTuner(
+                self._scan_tuner_key(), {"wb": wbs}, {"wb": cap})
+        return self._scan_tuner
+
+    def _warm_scan_arm(self, wb: int) -> None:
+        """Compile (and execute once, on an all-padding stack against a
+        throwaway carry) the W-bucket program an arm needs BEFORE its
+        first measured chunk, so exploration never compiles — and the
+        warm run never touches carried state. Keyed like the scan
+        cache; re-warms after bucket growth invalidates it."""
+        import jax.numpy as jnp
+
+        wb = seg_ops.bucket_size(wb)
+        warmed = getattr(self, "_warmed_scan_arms", None)
+        key3 = self._scan_key()
+        if warmed is None or warmed[0] != key3:
+            warmed = self._warmed_scan_arms = (key3, set())
+        if wb in warmed[1]:
+            return
+        # prime the program cache for THIS bucket (bypassing _scan_wb's
+        # bigger-bucket reuse — the arm must compile its own size)
+        if getattr(self, "_scan_cache_key", None) != key3:
+            self._scan_cache = {}
+            self._scan_cache_key = key3
+        fn = self._scan_fn_at(wb)
+        vb = self.vb
+        carry = (jnp.zeros(vb + 1, jnp.int32),
+                 jnp.arange(vb + 1, dtype=jnp.int32),
+                 jnp.arange(2 * vb + 1, dtype=jnp.int32))
+        s_w = jnp.full((wb, self.eb), vb, jnp.int32)
+        valid = jnp.zeros((wb, self.eb), jnp.bool_)
+        out = fn(carry, s_w, s_w, valid)
+        np.asarray(out[0][0])  # block: the compile must finish here
+        warmed[1].add(wb)
+
     def _scan_wb(self, num_w: int) -> int:
         """The W-bucket the snapshot scan will run `num_w` windows at
         — the bucket selection WITHOUT building a program, so the
@@ -571,7 +676,7 @@ class StreamingAnalyticsDriver:
         programs still compile for callers whose FIRST batch is small
         (the per-window dispatch mode)."""
         wb = seg_ops.bucket_size(min(num_w, self._scan_chunk()))
-        key3 = (self.vb, self.eb, self.analytics)
+        key3 = self._scan_key()
         if getattr(self, "_scan_cache_key", None) != key3:
             self._scan_cache = {}
             self._scan_cache_key = key3
@@ -581,10 +686,27 @@ class StreamingAnalyticsDriver:
                 wb = min(bigger)
         return wb
 
+    def _scan_key(self):
+        """Identity of the compiled snapshot-scan program family —
+        bucket growth, analytics, AND the egress format invalidate
+        the cache (a delta program emits a different out tree)."""
+        return (self.vb, self.eb, self.analytics, self._scan_egress())
+
+    def _scan_egress(self) -> str:
+        """The batched scan's d2h egress format: the constructor pin,
+        else the committed-evidence resolution (ops/delta_egress).
+        Sharded meshes always run full-vector egress — their snapshots
+        ride replicated shard_map outputs, symmetric to compact
+        ingress staying off the mesh path."""
+        if self.mesh is not None:
+            return "full"
+        return self._egress_pin or delta_egress.resolve_egress()
+
     def _scan_fn_at(self, wb: int):
         """Jitted snapshot scan for exactly W-bucket `wb` (selection
         already applied by _scan_wb), cached per
-        (vb, eb, analytics, W-bucket) — O(log) programs total."""
+        (vb, eb, analytics, egress, W-bucket) — O(log) programs
+        total."""
         if wb not in self._scan_cache:
             if self.mesh is not None:
                 from ..parallel.sharded import make_sharded_snapshot_scan
@@ -594,7 +716,9 @@ class StreamingAnalyticsDriver:
                     deltas=self.emit_deltas)
             else:
                 self._scan_cache[wb] = _build_snapshot_scan(
-                    self.vb, self.analytics, deltas=self.emit_deltas)
+                    self.vb, self.analytics, deltas=self.emit_deltas,
+                    egress=self._scan_egress(),
+                    cap=delta_egress.egress_cap(self.eb, self.vb))
         return self._scan_cache[wb]
 
     def _run_batched(self, windows,
@@ -734,22 +858,11 @@ class StreamingAnalyticsDriver:
         # snapshot below, same semantics as the scan's device masks).
         native_state = None
         if run_scan and not sharded and tier in ("native", "host"):
-            deg32 = lab = cov = None
-            if "degrees" in self.analytics:
-                deg32 = np.zeros(self.vb, np.int32)
-                deg32[:len(self._degrees)] = self._degrees
-            if "cc" in self.analytics:
-                lab = np.arange(self.vb, dtype=np.int32)
-                lab[:len(self._cc)] = self._cc
-            if "bipartite" in self.analytics:
-                if len(self._bip) != 2 * self.vb:
-                    self._bip = self._grow_cover(self._bip, self.vb)
-                # COPY (never alias the mirror): the C++ kernel folds
-                # unions in place mid-chunk, and mirrors must only
-                # move at chunk boundaries (the consistency unit —
-                # an exception mid-chunk leaves them resumable)
-                cov = self._bip.astype(np.int32)
-            native_state = (deg32, lab, cov)
+            # COPIES (never aliases of the mirrors): the C++/numpy
+            # kernels fold unions in place mid-chunk, and mirrors must
+            # only move at chunk boundaries (the consistency unit —
+            # an exception mid-chunk leaves them resumable)
+            native_state = self._chunk_start_state()
         carry = None
         if run_scan and sharded:
             # carried state straight from the engine (its layouts:
@@ -785,7 +898,33 @@ class StreamingAnalyticsDriver:
         # order; an exception mid-call still leaves the driver at the
         # last FINALIZED chunk (resumable). The host/native tier stays
         # synchronous — one core, nothing to overlap with.
+        def _boundary(at, chunk):
+            # chunk boundary: cursors, the partial flag, and the
+            # checkpoint move together (mirrors moved just before)
+            self.windows_done += len(chunk)
+            self.edges_done += sum(
+                len(s) for _w, s, _d, _n in chunk)
+            if closes_partial and at + len(chunk) >= num_w:
+                # the short final window lives in this chunk: the flag
+                # joins this boundary's state (and its checkpoint),
+                # never an earlier one's
+                self._closed_partial = True
+            if self._ckpt_due():
+                self._stage_ckpt()
+
         def _finalize_chunk(at, chunk, outs):
+            if any(key in outs for key in
+                   ("deg_cnt", "labels_cnt", "cover_cnt")):
+                # delta-compacted egress (ops/delta_egress)
+                if self._delta_overflowed(outs):
+                    # a label cascade outran the changed-slot cap:
+                    # the chunk refolds on the bit-exact host twin
+                    # and takes the full-vector extraction below
+                    outs = self._refold_chunk_outs(chunk)
+                else:
+                    self._emit_delta_chunk(chunk, outs, results)
+                    _boundary(at, chunk)
+                    return
             nv_chunk = chunk[-1][3]
             last = len(chunk) - 1
             for i, (wstart, s, d, nv) in enumerate(chunk):
@@ -866,16 +1005,7 @@ class StreamingAnalyticsDriver:
                     self._cc = outs["labels"][last][:nv_chunk].copy()
                 if "cover" in outs:
                     self._bip = outs["cover"][last][:2 * vb].copy()
-            self.windows_done += len(chunk)
-            self.edges_done += sum(
-                len(s) for _w, s, _d, _n in chunk)
-            if closes_partial and at + scan_chunk >= num_w:
-                # the short final window lives in this chunk: the flag
-                # joins this boundary's state (and its checkpoint),
-                # never an earlier one's
-                self._closed_partial = True
-            if self._ckpt_due():
-                self._stage_ckpt()
+            _boundary(at, chunk)
 
         pending = None  # (at, chunk, device outs)
 
@@ -917,10 +1047,56 @@ class StreamingAnalyticsDriver:
         fold = (native.snapshot_windows if tier == "native"
                 else host_snapshot.snapshot_windows)
 
+        # online wb tuning of the DEVICE scan branch (ops/autotune):
+        # each chunk is one measurement round; the arm (its window
+        # count) is decided — and its W-bucket program warmed — at the
+        # chunk's PREP-submit point, so exploration never compiles
+        # mid-measurement. GS_AUTOTUNE=0 (or the native/host/sharded
+        # branches) keeps the static scan_chunk stepping bit-identically.
+        tuner = (self._ensure_scan_tuner()
+                 if run_scan and not sharded and native_state is None
+                 else None)
+        decided = {}  # chunk start -> (take, arm)
+
+        def _decide(pos):
+            if pos not in decided:
+                if tuner is None:
+                    decided[pos] = (scan_chunk, None)
+                else:
+                    arm = (tuner.best()
+                           if ingress_pipeline.forced_sync_active()
+                           else tuner.next_round())
+                    take = min(arm["wb"], num_w - pos)
+                    # warm the bucket the round will ACTUALLY dispatch
+                    # (not the arm's full rung): pre-compiling an
+                    # oversized bucket would hand _scan_wb's
+                    # bigger-bucket reuse to every small call, making
+                    # 2-window pieces pay a full-rung scan of
+                    # sentinel rows
+                    self._warm_scan_arm(take)
+                    decided[pos] = (take, arm)
+            return decided[pos]
+
+        meas = None  # (arm, edges, t0) of the chunk last dispatched
+
+        def _meas_flush():
+            nonlocal meas
+            if meas is not None and tuner is not None \
+                    and not ingress_pipeline.forced_sync_active():
+                arm, edges, t0 = meas
+                if arm is not None:
+                    import time as _time
+
+                    tuner.record(arm, edges,
+                                 _time.perf_counter() - t0)
+            meas = None
+
         def _chunk_loop():
-          nonlocal carry, native_state, pending, prefetched
-          for at in range(0, num_w, scan_chunk):
-            chunk = interned[at:at + scan_chunk]
+          nonlocal carry, native_state, pending, prefetched, meas
+          at = 0
+          while at < num_w:
+            take, cur_arm = _decide(at)
+            chunk = interned[at:at + take]
             outs = {}
             if run_scan and native_state is not None:
                 flat_s = np.concatenate(
@@ -946,26 +1122,7 @@ class StreamingAnalyticsDriver:
                     outs = resilience.call_guarded(
                         "dispatch", at, _fold, retries=0)
                 if prevs is not None:
-                    # changed-slot masks vs the previous window's
-                    # snapshot (row -1 = chunk-start carried state) —
-                    # the scan tier's mask semantics: raw values for
-                    # degrees/labels, the consumer-visible ODD flag
-                    # for the cover
-                    pd, pl, pc = prevs
-                    if "deg" in outs:
-                        outs["deg_chg"] = outs["deg"] != np.concatenate(
-                            [pd[None], outs["deg"][:-1]])
-                    if "labels" in outs:
-                        outs["labels_chg"] = (
-                            outs["labels"] != np.concatenate(
-                                [pl[None], outs["labels"][:-1]]))
-                    if "cover" in outs:
-                        odd = (outs["cover"][:, :self.vb]
-                               == outs["cover"][:, self.vb:])
-                        podd = (pc[:self.vb] == pc[self.vb:])[None]
-                        outs["cover_chg"] = odd != np.concatenate(
-                            [podd, odd[:-1]])
-                        outs["_odd_rows"] = odd  # reused at extraction
+                    self._host_mask_outs(outs, prevs)
             elif run_scan:
                 if prefetched is not None and prefetched[0] == at:
                     timeout = resilience.stage_timeout_s()
@@ -991,19 +1148,40 @@ class StreamingAnalyticsDriver:
                         (chunk, self._scan_wb(len(chunk))))
                 prefetched = None
                 fn = self._scan_fn_at(wb)
+                # close the previous chunk's measurement BEFORE the
+                # next arm's decide: an exploration arm's warm-up
+                # (compile + throwaway dispatch inside _decide →
+                # _warm_scan_arm) must never bleed into the
+                # incumbent's recorded interval
+                _meas_flush()
                 # submit the NEXT chunk's prep only after this chunk's
                 # program is in the cache, so the ragged final chunk's
                 # bigger-bucket reuse sees it (no tail compile) and
                 # the worker itself never touches the cache
-                nxt = at + scan_chunk
+                nxt = at + take
                 if nxt < num_w:
-                    nxt_chunk = interned[nxt:nxt + scan_chunk]
+                    nxt_take, _ = _decide(nxt)
+                    nxt_chunk = interned[nxt:nxt + nxt_take]
                     nxt_item = (nxt_chunk,
                                 self._scan_wb(len(nxt_chunk)))
                     fut = ingress_pipeline.submit_prep(
                         _build_stack, nxt_item)
                     if fut is not None:
                         prefetched = (nxt, fut, nxt_item)
+                # one measurement round per chunk (the dispatch-to-
+                # dispatch interval is the pipelined steady state's
+                # per-chunk wall time). Recorded only when the chunk
+                # is full-rung OR the whole call fits under the rung
+                # (small stream_file pieces: ragged IS their real
+                # economics); a long call's final ragged tail is
+                # skipped — its amortization would drag the arm's EMA
+                if tuner is not None and cur_arm is not None \
+                        and len(chunk) == min(cur_arm["wb"], num_w):
+                    import time as _time
+
+                    meas = (cur_arm,
+                            sum(len(s) for _w, s, _d, _n in chunk),
+                            _time.perf_counter())
                 with self._step("snapshot_scan",
                                 sum(len(s) for _w, s, _d, _n in chunk)):
                     # async dispatch: returns device arrays without
@@ -1024,14 +1202,22 @@ class StreamingAnalyticsDriver:
                     carry, outs = resilience.call_guarded(
                         "dispatch", at, _disp,
                         retries=resilience.stage_retries())
+                    if "cover_cnt" in outs:
+                        # delta egress ships odd-flag deltas, which
+                        # cannot resync the cover-label mirror; the
+                        # chunk's final cover IS the carry — one
+                        # [2vb+1] d2h per chunk instead of [W, 2vb]
+                        outs["cover_final"] = carry[2]
                 finalize_pending()
                 pending = (at, chunk, outs)
+                at += take
                 continue
             # only the device-scan branch (which `continue`s above)
             # ever sets `pending`, and branch selection is fixed for
             # the whole call — the sync tiers never have one in flight
             assert pending is None
             _finalize_chunk(at, chunk, outs)
+            at += take
 
         try:
             _chunk_loop()
@@ -1047,6 +1233,154 @@ class StreamingAnalyticsDriver:
                 pending = None
             raise
         finalize_pending()
+        _meas_flush()
+        if tuner is not None:
+            tuner.save()
+
+    def _host_mask_outs(self, outs: dict, prevs: tuple) -> None:
+        """Changed-slot masks vs the previous window's snapshot
+        (row -1 = the chunk-start carried state in `prevs`) for
+        full-vector `outs` from the host/native fold — the scan tier's
+        mask semantics: raw values for degrees/labels, the
+        consumer-visible ODD flag for the cover."""
+        pd, pl, pc = prevs
+        if "deg" in outs:
+            outs["deg_chg"] = outs["deg"] != np.concatenate(
+                [pd[None], outs["deg"][:-1]])
+        if "labels" in outs:
+            outs["labels_chg"] = (
+                outs["labels"] != np.concatenate(
+                    [pl[None], outs["labels"][:-1]]))
+        if "cover" in outs:
+            odd = (outs["cover"][:, :self.vb]
+                   == outs["cover"][:, self.vb:])
+            podd = (pc[:self.vb] == pc[self.vb:])[None]
+            outs["cover_chg"] = odd != np.concatenate(
+                [podd, odd[:-1]])
+            outs["_odd_rows"] = odd  # reused at extraction
+
+    # ------------------------------------------------------------------
+    # delta-compacted d2h egress (ops/delta_egress): decode + fallback
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _delta_overflowed(outs: dict) -> bool:
+        """True when any window's changed count exceeded the wire's
+        [cap]-sized index row (its idx/val rows are then truncated —
+        the chunk must refold on the host twin)."""
+        for key in ("deg", "labels", "cover"):
+            if key + "_cnt" in outs and int(
+                    np.max(outs[key + "_cnt"])
+                    ) > outs[key + "_idx"].shape[1]:
+                return True
+        return False
+
+    def _chunk_start_state(self):
+        """Fresh int32 copies of the carried mirrors in the host-fold
+        layouts — the (deg, cc, cov) a chunk's host/native fold (or a
+        delta-egress refold) may mutate freely without moving the real
+        mirrors before the chunk boundary."""
+        vb = self.vb
+        deg32 = lab = cov = None
+        if "degrees" in self.analytics:
+            deg32 = np.zeros(vb, np.int32)
+            deg32[:len(self._degrees)] = self._degrees
+        if "cc" in self.analytics:
+            lab = np.arange(vb, dtype=np.int32)
+            lab[:len(self._cc)] = self._cc
+        if "bipartite" in self.analytics:
+            if len(self._bip) != 2 * vb:
+                self._bip = self._grow_cover(self._bip, vb)
+            cov = self._bip.astype(np.int32)
+        return deg32, lab, cov
+
+    def _refold_chunk_outs(self, chunk) -> dict:
+        """Delta-egress overflow fallback: recompute one chunk's FULL
+        snapshot rows with the bit-exact numpy twin
+        (ops/host_snapshot) from the chunk-start mirrors. Rare by
+        construction (a label cascade wider than the cap); exactness
+        therefore never depends on the cap choice."""
+        deg32, lab, cov = self._chunk_start_state()
+        prevs = (tuple(a.copy() if a is not None else None
+                       for a in (deg32, lab, cov))
+                 if self.emit_deltas else None)
+        flat_s = np.concatenate([s for _w, s, _d, _n in chunk])
+        flat_d = np.concatenate([d for _w, _s, d, _n in chunk])
+        offs = np.zeros(len(chunk) + 1, np.int64)
+        offs[1:] = np.cumsum([len(s) for _w, s, _d, _n in chunk])
+        outs = host_snapshot.snapshot_windows(
+            flat_s, flat_d, offs, self.vb, deg32, lab, cov)
+        if prevs is not None:
+            self._host_mask_outs(outs, prevs)
+        return outs
+
+    def _emit_delta_chunk(self, chunk, outs: dict,
+                          results: List[WindowResult]) -> None:
+        """Decode one chunk's delta-egress wire: apply each window's
+        (idx, vals) pairs to working copies of the carried mirrors —
+        the working copy after window w IS window w's snapshot — and
+        advance the mirrors to the chunk end. Bit-identical to the
+        full-vector extraction: a changed-mask applied to the previous
+        snapshot is exactly the next snapshot, and slots the window
+        never touched are unchanged by definition."""
+        vb = self.vb
+        want_deg = "deg_cnt" in outs
+        want_cc = "labels_cnt" in outs
+        want_bip = "cover_cnt" in outs
+        if want_deg:
+            deg_work = np.zeros(vb, np.int64)
+            deg_work[:len(self._degrees)] = self._degrees
+        if want_cc:
+            lab_work = np.arange(vb, dtype=np.int32)
+            lab_work[:len(self._cc)] = self._cc
+        if want_bip:
+            if len(self._bip) != 2 * vb:
+                self._bip = self._grow_cover(self._bip, vb)
+            odd_work = self._bip[:vb] == self._bip[vb:2 * vb]
+        for i, (wstart, s, d, nv) in enumerate(chunk):
+            res = WindowResult(
+                window_start=wstart, num_edges=len(s),
+                vertex_ids=self._vertex_ids(nv))
+            if want_deg:
+                k = int(outs["deg_cnt"][i])
+                idx = outs["deg_idx"][i][:k].copy()
+                vals = outs["deg_val"][i][:k].astype(np.int64)
+                self._check_degree_width(vals)
+                delta_egress.apply_delta(deg_work, k, idx, vals)
+                res.degrees = _snapshot_view(deg_work[:nv].copy())
+                if self.emit_deltas:
+                    res.delta_degrees = _frozen_delta(idx, vals)
+            if want_cc:
+                k = int(outs["labels_cnt"][i])
+                idx = outs["labels_idx"][i][:k].copy()
+                vals = outs["labels_val"][i][:k].copy()
+                delta_egress.apply_delta(lab_work, k, idx, vals)
+                res.cc_labels = _snapshot_view(lab_work[:nv].copy())
+                if self.emit_deltas:
+                    res.delta_cc = _frozen_delta(idx, vals)
+            if want_bip:
+                k = int(outs["cover_cnt"][i])
+                idx = outs["cover_idx"][i][:k].copy()
+                vals = outs["cover_val"][i][:k].copy()
+                delta_egress.apply_delta(odd_work, k, idx, vals)
+                res.bipartite_odd = _snapshot_view(odd_work[:nv].copy())
+                if self.emit_deltas:
+                    res.delta_bipartite = _frozen_delta(idx, vals)
+            if "triangles" in self.analytics:
+                self._tri_pending.append(
+                    (res, np.asarray(s, np.int32),
+                     np.asarray(d, np.int32)))
+            results.append(res)
+        # chunk boundary: mirrors advance to the chunk's final state —
+        # degree/label mirrors ARE the fully-applied working copies;
+        # the cover mirror resyncs from the carry the dispatch attached
+        nv_chunk = chunk[-1][3]
+        if want_deg:
+            self._degrees = deg_work[:nv_chunk].copy()
+            self._deg_state = None  # per-window path: rebuild
+        if want_cc:
+            self._cc = lab_work[:nv_chunk].copy()
+        if want_bip:
+            self._bip = np.asarray(outs["cover_final"])[:2 * vb].copy()
 
     def _stage_ckpt(self) -> None:
         """Stage a due auto-checkpoint instead of saving it inline.
@@ -1413,6 +1747,10 @@ class StreamingAnalyticsDriver:
         }
         if self._engine is not None:
             state["engine"] = self._engine.state_dict()
+        if getattr(self, "_scan_tuner", None) is not None:
+            # the learned dispatch configuration rides the checkpoint
+            # so a resumed stream keeps its optimum (ops/autotune)
+            state["autotune"] = self._scan_tuner.state_dict()
         return state
 
     def load_state_dict(self, state: dict) -> None:
@@ -1469,6 +1807,12 @@ class StreamingAnalyticsDriver:
         self._ensure_buckets(len(state["vertex_ids"]), 1)
         if self._engine is not None and "engine" in state:
             self._engine.load_state_dict(state["engine"])
+        # .get: checkpoints predating the autotune key restore cleanly;
+        # with GS_AUTOTUNE=0 the state is carried nowhere (inert)
+        if state.get("autotune") is not None and self.mesh is None:
+            tuner = self._ensure_scan_tuner()
+            if tuner is not None:
+                tuner.load_state_dict(state["autotune"])
 
     def trace_report(self) -> List[dict]:
         return self.timer.report() if self.timer else []

@@ -185,6 +185,15 @@ def pipeline_enabled() -> bool:
     return worker_count() > 0
 
 
+def forced_sync_active() -> bool:
+    """True while any forced_sync() context is live — the explicit A/B
+    measurement lever. The dispatch autotuner FREEZES under it (exploit
+    only, no recording), so a sync-baseline rep can neither pollute the
+    tuner's rate estimates nor be measured at a different configuration
+    than the pipelined rep it is compared against."""
+    return _FORCE_SYNC > 0
+
+
 class forced_sync:
     """Context manager pinning the synchronous single-threaded form —
     the A/B lever bench.py and the profiler use to measure the

@@ -97,6 +97,12 @@ class SummaryEngineBase:
     # committed evidence (tri_ops.resolve_ingress), the sharded engine
     # keeps the standard format (its chunks are mesh-sharded)
     ingress = "standard"
+    # online dispatch autotuning (ops/autotune.py): only the
+    # single-chip engine opts in — the sharded engine's jit programs
+    # have no AOT warm cache, so an arm change there would compile
+    # mid-measurement
+    AUTOTUNE = False
+    TUNABLE_INGRESS = False
 
     def reset(self) -> None:
         self._closed_partial = False
@@ -134,15 +140,20 @@ class SummaryEngineBase:
         """Full resumable state: the three carried vectors (d2h'd to
         host arrays) plus the windows_done cursor. The layout is the
         carry's own, shared by the single-chip and sharded engines, so
-        checkpoints are engine-interchangeable at equal buckets."""
+        checkpoints are engine-interchangeable at equal buckets. When
+        the online tuner is live, its learned state rides along so a
+        resumed stream keeps its configuration."""
         deg, labels, cover = (np.array(x) for x in self._carry)
-        return {
+        state = {
             "edge_bucket": self.eb,
             "vertex_bucket": self.vb,
             "windows_done": int(self.windows_done),
             "closed_partial": bool(self._closed_partial),
             "carry": (deg, labels, cover),
         }
+        if getattr(self, "_tuner", None) is not None:
+            state["autotune"] = self._tuner.state_dict()
+        return state
 
     def load_state_dict(self, state: dict) -> None:
         if state["edge_bucket"] != self.eb \
@@ -157,6 +168,13 @@ class SummaryEngineBase:
         self.windows_done = int(state["windows_done"])
         self._closed_partial = bool(state["closed_partial"])
         self._carry = tuple(jnp.asarray(a) for a in state["carry"])
+        # .get: checkpoints from before the autotune key (and engines
+        # with the tuner off) restore without it
+        if state.get("autotune") is not None and self.AUTOTUNE:
+            from . import autotune
+
+            if autotune.enabled():
+                self._ensure_tuner().load_state_dict(state["autotune"])
 
     def enable_auto_checkpoint(self, path: str,
                                every_n_windows: int = 16,
@@ -257,92 +275,22 @@ class SummaryEngineBase:
                 "(length not a multiple of edge_bucket); reset() before "
                 "feeding more of the stream")
         self._closed_partial = n % self.eb != 0
-        compact = self.ingress == "compact"
-        if compact:
-            from . import compact_ingress
-
-            # a wrapped id would corrupt ANOTHER vertex's carried
-            # state; the shared main-thread check raises the same
-            # ValueError every tier uses
-            compact_ingress.validate_ids(src, dst, self.vb + 1,
-                                         "fused summary scan")
-            num_w, s16, d16, nv = compact_ingress.window_stack(
-                src, dst, self.eb)
-        else:
-            num_w, s, d, valid = seg_ops.window_stack(
-                src, dst, self.eb, sentinel=self.vb)
+        num_w = -(-n // self.eb)
         out = []
         base = self.windows_done
         staged = []  # checkpoint snapshots due mid-call (see below)
 
-        # the shared three-stage ingress pipeline
-        # (ops/ingress_pipeline): chunk prep runs on the worker pool,
-        # dispatches stay in chunk order on this thread (the scan
-        # carry is sequential), and each chunk's d2h + extraction
-        # materializes one chunk behind its dispatch — host work hides
-        # behind device execution (same discipline as the driver's
-        # _run_batched and the triangle _run_stack_loop)
-        def prep(at):
-            hi = min(at + self.MAX_WINDOWS, num_w)
-            # ragged tails pad the window axis to a power-of-two bucket
-            # (all-invalid rows fold as no-ops against the carry), so
-            # varying stream lengths reuse O(log MAX_WINDOWS) programs
-            if compact:
-                sc, dc, nvc, real = compact_ingress.pad_chunk(
-                    s16, d16, nv, at, hi, self.MAX_WINDOWS, self.eb)
-                return at, real, (sc, dc, nvc)
-            sc, dc, vc, real = seg_ops.pad_window_chunk(
-                s, d, valid, at, hi, self.MAX_WINDOWS, self.eb,
-                self.vb)
-            return at, real, (sc, dc, vc)
+        from . import autotune
 
-        def h2d(payload):
-            at, real, args = payload
-            return at, real, self._h2d(args)
-
-        def dispatch(dev_payload):
-            at, real, dev = dev_payload
-            if (self._ckpt_path is not None and at
-                    and self._ckpt_policy.due(base + at)):
-                # the device carry at a chunk-DISPATCH boundary covers
-                # exactly the `base + at` windows dispatched so far —
-                # the one point where a bit-exact window-boundary
-                # snapshot costs a single d2h sync. The snapshot is
-                # STAGED and written only on clean process() return
-                # (the call is the delivery unit: a crash mid-call
-                # hands the caller nothing, so a flushed checkpoint
-                # covering this call's windows would make resume skip
-                # summaries never delivered — at-most-once).
-                self._ckpt_policy.mark(base + at)
-                snap = self.state_dict()
-                snap["windows_done"] = base + at
-                snap["closed_partial"] = False  # never mid-call
-                staged.append(snap)
-            raw = (self._dispatch_async_compact(*dev) if compact
-                   else self._dispatch_async(*dev))
-            return at, real, raw
-
-        def finalize(item):
-            f_at, f_real, raw = item
-            mdeg, ncomp, odd, tri, b_ovf, k_ovf = (
-                x[:f_real] for x in self._materialize(raw))
-            for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
-                lo = (f_at + int(w)) * self.eb
-                tri[w] = self._redo(src[lo:lo + self.eb],
-                                    dst[lo:lo + self.eb],
-                                    int(b_ovf[w]), int(k_ovf[w]))
-            for w in range(f_real):
-                out.append({
-                    "max_degree": int(mdeg[w]),
-                    "num_components": int(ncomp[w]),
-                    "odd_cycle": bool(odd[w]),
-                    "triangles": int(tri[w]),
-                })
-            self.windows_done += f_real
-
-        ingress_pipeline.run_pipeline(
-            range(0, num_w, self.MAX_WINDOWS),
-            prep, h2d, dispatch, finalize, timers=self.stage_timers)
+        if self.AUTOTUNE and autotune.enabled() \
+                and num_w > self.MAX_WINDOWS:
+            # long streams: the online tuner picks each round's
+            # (windows-per-dispatch, ingress) arm — identical
+            # summaries, measured dispatch knobs; GS_AUTOTUNE=0 (or a
+            # short call) runs the static path below bit-identically
+            self._process_tuned(src, dst, num_w, base, staged, out)
+        else:
+            self._process_static(src, dst, num_w, base, staged, out)
         if self._ckpt_path is not None:
             if self._ckpt_policy.due(self.windows_done):
                 self._ckpt_policy.mark(self.windows_done)
@@ -354,12 +302,235 @@ class SummaryEngineBase:
                 checkpoint.save(self._ckpt_path, snap)
         return out
 
+    # -- shared pipeline pieces (static path + autotuned rounds) -------
+
+    def _stage_ckpt_at(self, base: int, at: int, staged: list) -> None:
+        """Stage a due checkpoint at a chunk-DISPATCH boundary: the
+        device carry there covers exactly the `base + at` windows
+        dispatched so far — the one point where a bit-exact
+        window-boundary snapshot costs a single d2h sync. Snapshots
+        are written only on clean process() return (the call is the
+        delivery unit: a crash mid-call hands the caller nothing, so
+        a flushed checkpoint covering this call's windows would make
+        resume skip summaries never delivered — at-most-once)."""
+        if (self._ckpt_path is not None and at
+                and self._ckpt_policy.due(base + at)):
+            self._ckpt_policy.mark(base + at)
+            snap = self.state_dict()
+            snap["windows_done"] = base + at
+            snap["closed_partial"] = False  # never mid-call
+            staged.append(snap)
+
+    def _finalize_summaries(self, item, src, dst, out: list) -> None:
+        """Materialize one chunk's raw outputs into summary dicts
+        (exact overflow redo included) — the finalize stage both the
+        static and the tuned pipeline share."""
+        f_at, f_real, raw = item
+        mdeg, ncomp, odd, tri, b_ovf, k_ovf = (
+            x[:f_real] for x in self._materialize(raw))
+        for w in np.nonzero(b_ovf + k_ovf)[0]:  # exact redo
+            lo = (f_at + int(w)) * self.eb
+            tri[w] = self._redo(src[lo:lo + self.eb],
+                                dst[lo:lo + self.eb],
+                                int(b_ovf[w]), int(k_ovf[w]))
+        for w in range(f_real):
+            out.append({
+                "max_degree": int(mdeg[w]),
+                "num_components": int(ncomp[w]),
+                "odd_cycle": bool(odd[w]),
+                "triangles": int(tri[w]),
+            })
+        self.windows_done += f_real
+
+    def _run_window_rounds(self, src, dst, at0: int, hi_w: int,
+                           wb: int, compact: bool, data, base: int,
+                           staged: list, out: list) -> None:
+        """Windows [at0, hi_w) through the shared three-stage ingress
+        pipeline (ops/ingress_pipeline) at an explicit chunk size and
+        wire format: chunk prep runs on the worker pool, dispatches
+        stay in chunk order on this thread (the scan carry is
+        sequential), and each chunk's d2h + extraction materializes
+        one chunk behind its dispatch — host work hides behind device
+        execution (same discipline as the driver's _run_batched and
+        the triangle _run_stack_loop). `data` is the prebuilt
+        whole-stream stack in the chunk's wire format."""
+        def prep(at):
+            hi = min(at + wb, hi_w)
+            # ragged tails pad the window axis to a power-of-two bucket
+            # (all-invalid rows fold as no-ops against the carry), so
+            # varying stream lengths reuse O(log MAX_WINDOWS) programs
+            if data is None:
+                # tuned rounds: chunk stacks build from the raw COO on
+                # the (pooled) prep stage — exploring the other wire
+                # format must not hold a second whole-stream stack
+                lo = at * self.eb
+                hi_e = min(hi * self.eb, len(src))
+                if compact:
+                    from . import compact_ingress
+
+                    m, s16, d16, nv = compact_ingress.window_stack(
+                        src[lo:hi_e], dst[lo:hi_e], self.eb)
+                    sc, dc, nvc, real = compact_ingress.pad_chunk(
+                        s16, d16, nv, 0, m, wb, self.eb)
+                    return at, real, (sc, dc, nvc)
+                m, s, d, valid = seg_ops.window_stack(
+                    src[lo:hi_e], dst[lo:hi_e], self.eb,
+                    sentinel=self.vb)
+                sc, dc, vc, real = seg_ops.pad_window_chunk(
+                    s, d, valid, 0, m, wb, self.eb, self.vb)
+                return at, real, (sc, dc, vc)
+            if compact:
+                from . import compact_ingress
+
+                s16, d16, nv = data
+                sc, dc, nvc, real = compact_ingress.pad_chunk(
+                    s16, d16, nv, at, hi, wb, self.eb)
+                return at, real, (sc, dc, nvc)
+            s, d, valid = data
+            sc, dc, vc, real = seg_ops.pad_window_chunk(
+                s, d, valid, at, hi, wb, self.eb, self.vb)
+            return at, real, (sc, dc, vc)
+
+        def h2d(payload):
+            at, real, args = payload
+            return at, real, self._h2d(args)
+
+        def dispatch(dev_payload):
+            at, real, dev = dev_payload
+            self._stage_ckpt_at(base, at, staged)
+            raw = (self._dispatch_async_compact(*dev) if compact
+                   else self._dispatch_async(*dev))
+            return at, real, raw
+
+        def finalize(item):
+            self._finalize_summaries(item, src, dst, out)
+
+        ingress_pipeline.run_pipeline(
+            range(at0, hi_w, wb), prep, h2d, dispatch, finalize,
+            timers=self.stage_timers)
+
+    def _build_stack(self, src, dst, fmt: str):
+        """Whole-stream window stack in wire format `fmt` (compact
+        validates ids on the MAIN thread first — a wrapped id would
+        corrupt ANOTHER vertex's carried state, and callers must see
+        the same ValueError every tier raises)."""
+        if fmt == "compact":
+            from . import compact_ingress
+
+            compact_ingress.validate_ids(src, dst, self.vb + 1,
+                                         "fused summary scan")
+            return compact_ingress.window_stack(src, dst, self.eb)[1:]
+        return seg_ops.window_stack(src, dst, self.eb,
+                                    sentinel=self.vb)[1:]
+
+    def _process_static(self, src, dst, num_w: int, base: int,
+                        staged: list, out: list) -> None:
+        """The legacy single-configuration path: one pipeline over the
+        whole call at the statically resolved (MAX_WINDOWS, ingress)."""
+        compact = self.ingress == "compact"
+        data = self._build_stack(src, dst,
+                                 "compact" if compact else "standard")
+        self._run_window_rounds(src, dst, 0, num_w, self.MAX_WINDOWS,
+                                compact, data, base, staged, out)
+
+    # -- online autotuning (ops/autotune.py) ---------------------------
+
+    def _ensure_tuner(self):
+        from . import autotune
+        from . import compact_ingress
+
+        if getattr(self, "_tuner", None) is None:
+            wbm = self.MAX_WINDOWS
+            wbs = sorted({max(1, wbm // 4), max(1, wbm // 2), wbm})
+            ing = [self.ingress]
+            if self.TUNABLE_INGRESS \
+                    and not getattr(self, "_pinned_ingress", False):
+                ing = ["standard"]
+                if compact_ingress.supports(self.vb):
+                    ing.append("compact")
+            init = {"wb": wbm,
+                    "ingress": (self.ingress if self.ingress in ing
+                                else "standard")}
+            self._tuner = autotune.DispatchTuner(
+                "fused_scan:eb=%d:vb=%d" % (self.eb, self.vb),
+                {"wb": wbs, "ingress": ing}, init)
+        return self._tuner
+
+    def _warm_arm(self, arm: dict) -> None:
+        """Run one ALL-PADDING chunk at the arm's shape before its
+        first timed round: padded rows fold as no-ops against the
+        carry (values bit-identical), so this is a pure compile+warm
+        dispatch — steady-state rounds never compile mid-measurement."""
+        warmed = getattr(self, "_warmed_arms", None)
+        if warmed is None:
+            warmed = self._warmed_arms = set()
+        key = (arm["wb"], arm["ingress"])
+        if key in warmed:
+            return
+        wb = arm["wb"]
+        if arm["ingress"] == "compact":
+            z16 = np.zeros((wb, self.eb), np.uint16)
+            raw = self._dispatch_async_compact(
+                *self._h2d((z16, z16, np.zeros(wb, np.int32))))
+        else:
+            zi = np.full((wb, self.eb), self.vb, np.int32)
+            raw = self._dispatch_async(
+                *self._h2d((zi, zi, np.zeros((wb, self.eb), bool))))
+        self._materialize(raw)  # block until the compile completes
+        warmed.add(key)
+
+    def _process_tuned(self, src, dst, num_w: int, base: int,
+                       staged: list, out: list) -> None:
+        """The autotuned twin of _process_static: measurement rounds
+        of `autotune.round_chunks()` chunks each, arm-per-round, the
+        measured edges/s fed back to the tuner. Summaries are
+        identical at every arm; under forced_sync the tuner freezes
+        (see ingress_pipeline.forced_sync_active)."""
+        import time as _time
+
+        from . import autotune
+
+        tuner = self._ensure_tuner()
+        freeze = ingress_pipeline.forced_sync_active()
+        validated = False
+        round_len = autotune.round_chunks()
+        at0 = 0
+        while at0 < num_w:
+            arm = tuner.best() if freeze else tuner.next_round()
+            self._warm_arm(arm)
+            wb, fmt = arm["wb"], arm["ingress"]
+            if fmt == "compact" and not validated:
+                # the shared main-thread wrap-safety check, once per
+                # call (prep builds compact stacks on the pool)
+                from . import compact_ingress
+
+                compact_ingress.validate_ids(src, dst, self.vb + 1,
+                                             "fused summary scan")
+                validated = True
+            take = min(num_w - at0, round_len * wb)
+            t0 = _time.perf_counter()
+            self._run_window_rounds(src, dst, at0, at0 + take, wb,
+                                    fmt == "compact", None,
+                                    base, staged, out)
+            # full rounds (or a whole call smaller than one) only: a
+            # long call's ragged tail would drag the arm's EMA with
+            # tail economics
+            if not freeze and take == min(round_len * wb, num_w):
+                tuner.record(arm, take * self.eb,
+                             _time.perf_counter() - t0)
+            at0 += take
+        if not freeze:
+            tuner.save()
+
 
 class StreamSummaryEngine(SummaryEngineBase):
     """Single-chip carried-state analytics over chunks of windows, one
     dispatch per MAX_WINDOWS windows. Exact: triangle windows whose
     hubs overflow K are recounted by the escalating per-window
     kernel."""
+
+    AUTOTUNE = True
+    TUNABLE_INGRESS = True
 
     def __init__(self, edge_bucket: int, vertex_bucket: int,
                  k_bucket: int = 0, ingress: str = None):
@@ -385,6 +556,9 @@ class StreamSummaryEngine(SummaryEngineBase):
                     "(ids must fit uint16)" % self.vb)
         self.ingress = (ingress if ingress
                         else tri_ops.resolve_ingress(self.vb))
+        # an explicit pin freezes the wire format for the tuner too
+        # (the A/B tools must measure exactly what they pinned)
+        self._pinned_ingress = ingress is not None
         body = _build_scan(self.eb, self.vb, self.kb)
 
         @jax.jit
@@ -392,14 +566,25 @@ class StreamSummaryEngine(SummaryEngineBase):
             return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
 
         self._run = run
+        self._body = body
+        self._run_c = None  # compact twin, built on first use
         if self.ingress == "compact":
-            eb_, vb_ = self.eb, self.vb
+            self._ensure_compact_fn()
+        self._tri_fallback = tri_ops.TriangleWindowKernel(
+            edge_bucket=self.eb, vertex_bucket=self.vb,
+            k_bucket=4 * self.kb)
+        self.reset()
 
-            # the compact twin: the shared device-side decode
-            # (compact_ingress.widen_stack — widen uint16 ids +
-            # rebuild the suffix mask from per-window counts) fused
-            # into the same scan program, applied to the whole
-            # [W, eb] stack before the scan consumes it
+    def _ensure_compact_fn(self):
+        """The compact twin of _run: the shared device-side decode
+        (compact_ingress.widen_stack — widen uint16 ids + rebuild the
+        suffix mask from per-window counts) fused into the same scan
+        program, applied to the whole [W, eb] stack before the scan
+        consumes it. Built lazily so a standard-resolved engine whose
+        TUNER explores compact pays for it only when explored."""
+        if self._run_c is None:
+            eb_, vb_, body = self.eb, self.vb, self._body
+
             from . import compact_ingress as _ci
 
             @jax.jit
@@ -409,10 +594,7 @@ class StreamSummaryEngine(SummaryEngineBase):
                 return jax.lax.scan(body, carry, (s_w, d_w, valid_w))
 
             self._run_c = run_c
-        self._tri_fallback = tri_ops.TriangleWindowKernel(
-            edge_bucket=self.eb, vertex_bucket=self.vb,
-            k_bucket=4 * self.kb)
-        self.reset()
+        return self._run_c
 
     def _dispatch_async(self, s, d, valid):
         self._carry, outs = self._run(
@@ -421,7 +603,7 @@ class StreamSummaryEngine(SummaryEngineBase):
         return outs
 
     def _dispatch_async_compact(self, s16, d16, nvalid):
-        self._carry, outs = self._run_c(
+        self._carry, outs = self._ensure_compact_fn()(
             self._carry, jnp.asarray(s16), jnp.asarray(d16),
             jnp.asarray(nvalid))
         return outs

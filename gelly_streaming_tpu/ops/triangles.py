@@ -816,6 +816,12 @@ class TriangleWindowKernel:
                     "compact ingress is lossy for vertex_bucket %d "
                     "(ids must fit uint16)" % self.vb)
         self.ingress = ingress if ingress else resolve_ingress(self.vb)
+        # explicit constructor pins freeze those knobs for the online
+        # tuner too: an A/B tool or profiler sweep that pinned a K or
+        # a wire format must measure exactly that configuration
+        # (ops/autotune arms then vary only the unpinned knobs)
+        self._pinned_kb = bool(k_bucket)
+        self._pinned_ingress = ingress is not None
         # per-stage wall-time counters of every pipelined stream run
         # through this kernel (ops/ingress_pipeline.StageTimers);
         # tools/profile_kernels.py commits their snapshot to PERF.json
@@ -872,27 +878,33 @@ class TriangleWindowKernel:
 
         return run_stream
 
-    def _stream_exec(self, wb: int):
-        """AOT-compiled stream program for a [wb, eb] chunk at the
-        current K and ingress format, in the kernel's OWN cache:
-        warming via .lower().compile() never executes anything (jit's
-        internal shape cache is not populated by AOT compilation, so
-        the dispatch path must share this cache for compile-only
-        warming to stick)."""
-        key = (self.kb, wb, self.ingress)
+    def _stream_exec(self, wb: int, kb: int = None,
+                     ingress: str = None):
+        """AOT-compiled stream program for a [wb, eb] chunk at K `kb`
+        and wire format `ingress` (both default to the kernel's static
+        selection; the autotuner passes its arm's values), in the
+        kernel's OWN cache: warming via .lower().compile() never
+        executes anything (jit's internal shape cache is not populated
+        by AOT compilation, so the dispatch path must share this cache
+        for compile-only warming to stick)."""
+        kb = self.kb if kb is None else kb
+        ingress = self.ingress if ingress is None else ingress
+        key = (kb, wb, ingress)
         ex = self._stream_execs.get(key)
         if ex is None:
-            fkey = (self.kb, self.ingress)
+            if kb not in self._fns:
+                self._fns[kb] = self._build(kb)
+            fkey = (kb, ingress)
             if fkey not in self._stream_fns:
-                if self.ingress == "compact":
+                if ingress == "compact":
                     from . import compact_ingress
 
                     self._stream_fns[fkey] = jax.jit(
                         compact_ingress.build_stream_fn(
-                            self._fns[self.kb], self.vb, self.eb))
+                            self._fns[kb], self.vb, self.eb))
                 else:
-                    self._stream_fns[fkey] = self._build_stream(self.kb)
-            if self.ingress == "compact":
+                    self._stream_fns[fkey] = self._build_stream(kb)
+            if ingress == "compact":
                 sds_u = jax.ShapeDtypeStruct((wb, self.eb), jnp.uint16)
                 sds_n = jax.ShapeDtypeStruct((wb,), jnp.int32)
                 ex = self._stream_fns[fkey].lower(
@@ -992,6 +1004,148 @@ class TriangleWindowKernel:
 
         return self._run_stack_loop(num_w, make_chunk, recount)
 
+    # ---- online autotuning (ops/autotune.py) -------------------------
+
+    def _tuner_space(self) -> dict:
+        """The kernel's arm space: windows-per-dispatch rungs under the
+        (compile-capped) chunk limit, the first K rungs of the existing
+        escalation ladder (exactness guaranteed by the overflow recount
+        at ANY K), and the two parity-proven wire formats (compact only
+        when ids fit uint16 for this vertex bucket)."""
+        wb_max = self.MAX_STREAM_WINDOWS
+        wbs = sorted({max(1, wb_max // 4), max(1, wb_max // 2), wb_max})
+        if self._pinned_kb:
+            kbs = [self.kb]
+        else:
+            kbs = self._escalation_ladder()[:3]
+        ing = [self.ingress]
+        if not self._pinned_ingress:
+            from . import compact_ingress
+
+            ing = ["standard"]
+            if compact_ingress.supports(self.vb):
+                ing.append("compact")
+        return {"wb": wbs, "kb": sorted(set(kbs)), "ingress": ing}
+
+    def _ensure_tuner(self):
+        from . import autotune
+
+        if getattr(self, "tuner", None) is None:
+            self.tuner = autotune.DispatchTuner(
+                "triangle_stream:eb=%d:vb=%d" % (self.eb, self.vb),
+                self._tuner_space(),
+                {"wb": self.MAX_STREAM_WINDOWS, "kb": self.kb,
+                 "ingress": self.ingress})
+        return self.tuner
+
+    def _warm_arm(self, arm: dict) -> None:
+        """AOT-compile an arm's full-chunk stream program BEFORE its
+        first timed round (ragged tail buckets compile on first use at
+        the stream end, exactly like the legacy path's tail)."""
+        self._stream_exec(arm["wb"], kb=arm["kb"],
+                          ingress=arm["ingress"])
+
+    def _run_stack_tuned(self, src: np.ndarray,
+                         dst: np.ndarray) -> list:
+        """The autotuned twin of the _run_stack* paths: the stream is
+        folded in measurement ROUNDS of `autotune.round_chunks()`
+        dispatch chunks each; the tuner picks each round's
+        (windows-per-dispatch, K, ingress) arm, the round runs through
+        the SAME shared ingress pipeline, and its measured edges/s
+        feeds the tuner. Counts are identical to every static
+        configuration (same kernels, same overflow recounts); only
+        dispatch economics change. Under forced_sync (the bench's A/B
+        lever) the tuner FREEZES — the incumbent runs and nothing is
+        recorded."""
+        import time as _time
+
+        from . import autotune
+        from . import compact_ingress
+
+        eb = self.eb
+        n = len(src)
+        num_w = -(-n // eb)
+        tuner = self._ensure_tuner()
+        freeze = ingress_pipeline.forced_sync_active()
+
+        def recount(w: int, min_k: int) -> int:
+            return self.count(src[w * eb:(w + 1) * eb],
+                              dst[w * eb:(w + 1) * eb], min_k=min_k)
+
+        # chunk stacks build FROM THE RAW COO inside the (pooled) prep
+        # stage — no whole-stream per-format stacks: exploring the
+        # other wire format must not double the resident ingress
+        # memory of a long stream
+        def make_chunk(a, hi, wb, ingress):
+            lo, hi_e = a * eb, min(hi * eb, n)
+            if ingress == "compact":
+                m, s16, d16, nv = compact_ingress.window_stack(
+                    src[lo:hi_e], dst[lo:hi_e], eb)
+                sc, dc, nvc, m = compact_ingress.pad_chunk(
+                    s16, d16, nv, 0, m, wb, eb)
+                return (sc, dc, nvc), m
+            m, s, d, valid = seg_ops.window_stack(
+                src[lo:hi_e], dst[lo:hi_e], eb, sentinel=self.vb)
+            sc, dc, vc, m = seg_ops.pad_window_chunk(
+                s, d, valid, 0, m, wb, eb, self.vb)
+            return (sc, dc, vc), m
+
+        counts: list = []
+        round_len = autotune.round_chunks()
+        at = 0
+        while at < num_w:
+            arm = tuner.best() if freeze else tuner.next_round()
+            self._warm_arm(arm)
+            wb, kb, ingress = arm["wb"], arm["kb"], arm["ingress"]
+            take = min(num_w - at, round_len * wb)
+            t0 = _time.perf_counter()
+            self._run_window_range(at, at + take, wb, kb, ingress,
+                                   make_chunk, recount, counts)
+            # record full rounds (or a whole call smaller than one):
+            # a long stream's ragged tail has different per-edge
+            # amortization and would drag the arm's EMA (and the
+            # persisted cache) with tail economics
+            if not freeze and take == min(round_len * wb, num_w):
+                tuner.record(arm, take * eb,
+                             _time.perf_counter() - t0)
+            at += take
+        if not freeze:
+            tuner.save()
+        return counts
+
+    def _run_window_range(self, at0: int, hi_w: int, wb: int, kb: int,
+                          ingress: str, make_chunk, recount,
+                          counts: list) -> None:
+        """One round's windows [at0, hi_w) through the shared
+        three-stage ingress pipeline at an explicit arm — the
+        arm-parameterized core of _run_stack_loop."""
+
+        def prep(at):
+            hi = min(at + wb, hi_w)
+            args, m = make_chunk(at, hi, wb, ingress)
+            return at, m, args
+
+        def h2d(payload):
+            at, m, args = payload
+            return at, m, [jnp.asarray(a) for a in args]
+
+        def dispatch(dev_payload):
+            at, m, dev = dev_payload
+            c, o = self._stream_exec(dev[0].shape[0], kb=kb,
+                                     ingress=ingress)(*dev)
+            return at, m, c, o
+
+        def finalize(raw):
+            at, m, c_dev, o_dev = raw
+            c, o = np.array(c_dev)[:m], np.array(o_dev)[:m]
+            for w in np.nonzero(o)[0]:  # rare hub overflow: exact redo
+                c[w] = recount(at + int(w), kb)
+            counts.extend(int(x) for x in c)
+
+        ingress_pipeline.run_pipeline(
+            range(at0, hi_w, wb), prep, h2d, dispatch, finalize,
+            timers=self.stage_timers)
+
     def warm_chunks(self) -> None:
         """Compile every stream-chunk program _run_stack can dispatch
         at the current K, so a streaming consumer (the driver) pays
@@ -1034,8 +1188,17 @@ class TriangleWindowKernel:
     def _count_stream_device(self, src: np.ndarray,
                              dst: np.ndarray) -> list:
         """The device path of count_stream, selection bypassed (the
-        profiler measures both tiers through this split)."""
+        profiler measures both tiers through this split). Streams
+        longer than one maximal dispatch chunk route through the
+        online autotuner (GS_AUTOTUNE, ops/autotune.py) — identical
+        counts, live-measured dispatch knobs; GS_AUTOTUNE=0 (or a
+        short stream) runs the static-gate path below bit-identically."""
         eb = self.eb
+        from . import autotune
+
+        if autotune.enabled() \
+                and -(-len(src) // eb) > self.MAX_STREAM_WINDOWS:
+            return self._run_stack_tuned(src, dst)
         if self.ingress == "compact":
             from . import compact_ingress
 

@@ -92,6 +92,24 @@ def _resolve_reduce_impl(name: str, allow_native: bool = True) -> str:
     return impl
 
 
+def _device_cell_fill(name: str, dtype):
+    """The DEVICE segment kernels' empty-segment identity — what an
+    untouched cell of the full-egress [wb, vbp] stack holds: the XLA
+    reduce init values (segment sum → 0; segment min → +inf /
+    iinfo.max; segment max → -inf / iinfo.min). The delta-egress
+    decode refills reconstructed rows with it so both egress formats
+    are bit-identical cell-for-cell, not just on touched cells (cells
+    with count 0 are contractually compared by count, but the bit
+    contract keeps the A/B's sha256 assertion meaningful)."""
+    dtype = np.dtype(dtype)
+    if name == "sum":
+        return dtype.type(0)
+    if np.issubdtype(dtype, np.floating):
+        return np.inf if name == "min" else -np.inf
+    info = np.iinfo(dtype)
+    return info.max if name == "min" else info.min
+
+
 def _host_identity(name: str, dtype):
     """Monoid identity for the HOST (numpy) tiers and the reference
     oracle — one definition so the tier, the oracle, and any future
@@ -130,9 +148,11 @@ class WindowedEdgeReduce:
 
     def __init__(self, vertex_bucket: int, edge_bucket: int,
                  name: str = "sum", direction: str = "out",
-                 fn=None, ingress: str = None):
+                 fn=None, ingress: str = None, egress: str = None):
         if direction not in _DIRECTIONS:
             raise ValueError(f"direction must be one of {_DIRECTIONS}")
+        if egress not in (None, "full", "delta"):
+            raise ValueError(f"unknown egress: {egress!r}")
         if fn is not None:
             name = None
         assert name in (None, "sum", "min", "max"), name
@@ -167,6 +187,15 @@ class WindowedEdgeReduce:
                     "(ids must fit uint16)" % self.vb)
         self.ingress = (ingress if ingress
                         else _tri.resolve_ingress(self.vb))
+        # d2h egress of the monoid DEVICE tier: full [wb, vbp]
+        # cells+counts stacks, or the touched-cell delta wire
+        # (ops/delta_egress — a window touches at most one cell per
+        # contribution, so the [cap]-sized wire is exact, no overflow
+        # path needed). Same pin/evidence selection as the driver's
+        # snapshot egress.
+        from . import delta_egress as _de
+
+        self.egress = egress if egress else _de.resolve_egress()
         from . import ingress_pipeline as _ip
 
         self.stage_timers = _ip.StageTimers()
@@ -174,8 +203,26 @@ class WindowedEdgeReduce:
 
     # ---- jitted stack program (monoid tier) ---------------------------
 
-    def _stack_fn(self, wb: int):
-        fn = self._fns.get(wb)
+    def _delta_cap(self) -> int:
+        """Exact per-window touched-cell bound of the delta egress
+        wire: one cell per contribution (two for direction 'all'),
+        never more than the row width."""
+        per = self.eb * (2 if self.direction == "all" else 1)
+        return min(per, self.vb + 1)
+
+    def _delta_tail(self, cap: int):
+        """The vmapped per-window encode appended to a stack program
+        when egress is delta (ops/delta_egress.compact_touched)."""
+        import jax
+
+        from . import delta_egress
+
+        return jax.vmap(
+            lambda c, n: delta_egress.compact_touched(c, n, cap))
+
+    def _stack_fn(self, wb: int, delta: bool = False):
+        key = (wb, delta)
+        fn = self._fns.get(key)
         if fn is None:
             import jax
             import jax.numpy as jnp
@@ -183,6 +230,7 @@ class WindowedEdgeReduce:
             vbp = self.vb + 1
             n_cells = wb * vbp
             name = self.name
+            tail = self._delta_tail(self._delta_cap()) if delta else None
 
             @jax.jit
             def run(ids, vals):
@@ -191,18 +239,18 @@ class WindowedEdgeReduce:
                 counts = jax.ops.segment_sum(
                     jnp.where(ids < n_cells, 1, 0), ids,
                     n_cells + 1)[:-1].reshape(wb, vbp)
-                return cells, counts
+                return tail(cells, counts) if tail else (cells, counts)
 
-            self._fns[wb] = fn = run
+            self._fns[key] = fn = run
         return fn
 
-    def _stack_fn_compact(self, wb: int):
+    def _stack_fn_compact(self, wb: int, delta: bool = False):
         """Compact twin of _stack_fn: consumes [wb, eb] uint16 id
         stacks + [wb] valid counts + [wb, eb] values, rebuilds the
         suffix mask and the flattened (window, vertex) cell ids ON
         DEVICE (the widening fused into the same program), then runs
         the identical segment kernels — same cells/counts."""
-        key = ("compact", wb)
+        key = ("compact", wb, delta)
         fn = self._fns.get(key)
         if fn is None:
             import jax
@@ -215,6 +263,8 @@ class WindowedEdgeReduce:
             direction = self.direction
 
             from . import compact_ingress
+
+            tail = self._delta_tail(self._delta_cap()) if delta else None
 
             @jax.jit
             def run(s16, d16, nvalid, vals):
@@ -240,7 +290,7 @@ class WindowedEdgeReduce:
                 counts = jax.ops.segment_sum(
                     jnp.where(ids < n_cells, 1, 0), ids,
                     n_cells + 1)[:-1].reshape(wb, vbp)
-                return cells, counts
+                return tail(cells, counts) if tail else (cells, counts)
 
             self._fns[key] = fn = run
         return fn
@@ -410,14 +460,33 @@ class WindowedEdgeReduce:
             at, wb, args = payload
             return at, wb, tuple(jnp.asarray(a) for a in args)
 
+        delta = self.egress == "delta"
+
         def dispatch(dev_payload):
             at, wb, dev = dev_payload
-            fn = (self._stack_fn_compact(wb) if compact
-                  else self._stack_fn(wb))
-            cells, counts = fn(*dev)
-            return at, wb, cells, counts
+            fn = (self._stack_fn_compact(wb, delta) if compact
+                  else self._stack_fn(wb, delta))
+            return (at, wb) + tuple(fn(*dev))
 
         def finalize(raw):
+            if delta:
+                # touched-cell wire (ops/delta_egress): d2h one
+                # (cnt, idx, cells, counts) [wb, cap] quad instead of
+                # two full [wb, vbp] stacks; untouched cells refill
+                # with the device kernels' own empty-segment identity,
+                # so rows are bit-identical to the full tier's
+                at, wb, cnt, idx, cv, cn = raw
+                cnt, idx, cv, cn = (np.asarray(x)
+                                    for x in (cnt, idx, cv, cn))
+                fill = _device_cell_fill(self.name, cv.dtype)
+                for w in range(min(wb, num_w - at)):
+                    k = int(cnt[w])
+                    cells = np.full(vbp, fill, cv.dtype)
+                    counts = np.zeros(vbp, cn.dtype)
+                    cells[idx[w, :k]] = cv[w, :k]
+                    counts[idx[w, :k]] = cn[w, :k]
+                    out.append((cells, counts))
+                return
             at, wb, cells, counts = raw
             cells, counts = np.asarray(cells), np.asarray(counts)
             for w in range(min(wb, num_w - at)):

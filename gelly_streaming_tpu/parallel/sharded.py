@@ -33,7 +33,7 @@ except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 
 from .mesh import (SHARD_AXIS, make_mesh, mesh_padded_len,
-                   pad_edges_for_mesh, shard_count)
+                   pad_edges_for_mesh, shard_count, shard_map_norep)
 from ..ops import ingress_pipeline, scan_analytics
 from ..ops import segment as seg_ops
 from ..ops import triangles, unionfind
@@ -77,9 +77,10 @@ def make_sharded_cc_fn(mesh, num_vertices_bucket: int):
     arange for a fresh window or carry the previous state for the
     streaming-iteration semantics of IterativeConnectedComponents."""
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
+    # shard_map_norep: cc_fixpoint's while_loop has no replication rule
+    # in the checker; the pmin exchange makes the output replicated
+    @shard_map_norep(
+        mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P()),
         out_specs=P(),
     )
     def step(src, dst, labels):
@@ -200,10 +201,13 @@ def make_sharded_pane_reduce(mesh, vertex_bucket: int, pane_bucket: int,
 
         return jax.jit(run)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
-                  P(SHARD_AXIS)),
+    # shard_map_norep: a user fn declared associative may trace a
+    # while_loop (e.g. np.gcd lowers to one), which the replication
+    # checker has no rule for; outputs are made replicated explicitly
+    # (psum counts, the no-op pmax on accv below)
+    @shard_map_norep(
+        mesh, in_specs=(P(SHARD_AXIS), P(SHARD_AXIS), P(SHARD_AXIS),
+                        P(SHARD_AXIS)),
         out_specs=(P(), P()),
     )
     def assoc_partials(src, pane, val, valid):
@@ -973,9 +977,11 @@ def make_sharded_summary_scan(mesh, eb: int, vb: int, kb: int, cap: int,
         return (deg, labels, cover), (
             max_degree, num_components, odd, tri, b_ovf, k_ovf)
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=(
+    # shard_map_norep: the scan body runs cc_fixpoint (a while_loop
+    # the replication checker cannot type); psum/pmin exchanges make
+    # every output replicated
+    @shard_map_norep(
+        mesh, in_specs=(
             (P(), P(), P()),                               # carry
             P(None, SHARD_AXIS), P(None, SHARD_AXIS),      # [W, eb]
             P(None, SHARD_AXIS),
@@ -1062,11 +1068,12 @@ def make_sharded_snapshot_scan(mesh, vb: int, analytics: tuple,
         if deltas:
             out_tree["cover_chg"] = P()
 
-    @functools.partial(
-        shard_map, mesh=mesh,
-        in_specs=((P(), P(), P()),
-                  P(None, SHARD_AXIS), P(None, SHARD_AXIS),
-                  P(None, SHARD_AXIS)),
+    # shard_map_norep: same while_loop (cc_fixpoint) shape as the
+    # summary scan above; psum/pmin make the outputs replicated
+    @shard_map_norep(
+        mesh, in_specs=((P(), P(), P()),
+                        P(None, SHARD_AXIS), P(None, SHARD_AXIS),
+                        P(None, SHARD_AXIS)),
         out_specs=((P(), P(), P()), out_tree),
     )
     def run(carry, s_w, d_w, valid_w):

@@ -1,0 +1,241 @@
+"""Online dispatch autotuner (ops/autotune.py): deterministic
+decisions, hysteresis, cache/checkpoint round-trips, and the hard
+contract that GS_AUTOTUNE=0 — and the tuner being ON — never changes
+results, only dispatch economics."""
+
+import hashlib
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+from gelly_streaming_tpu.ops import autotune
+from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+
+@pytest.fixture(autouse=True)
+def _isolated_cache(tmp_path, monkeypatch):
+    """Every test gets a private tuning cache (and leaves the
+    process-wide env untouched)."""
+    monkeypatch.setenv("GS_TUNE_CACHE", str(tmp_path / "tune"))
+    yield
+
+
+def _stream(n, vmax, seed=3):
+    rng = np.random.default_rng(seed)
+    src = rng.integers(0, vmax, n).astype(np.int64)
+    dst = (src + 1 + rng.integers(0, vmax - 1, n)) % vmax
+    return src, dst.astype(np.int64)
+
+
+# ----------------------------------------------------------------------
+# the tuner object
+# ----------------------------------------------------------------------
+def _tuner(**kw):
+    kw.setdefault("key", "t:eb=8:vb=8")
+    kw.setdefault("space", {"wb": [2, 4, 8]})
+    kw.setdefault("initial", {"wb": 8})
+    return autotune.DispatchTuner(**kw)
+
+
+def test_exploit_by_default_explore_on_cadence(monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE_EXPLORE", "3")
+    t = _tuner()
+    seen = []
+    for _ in range(6):
+        arm = t.next_round()
+        seen.append(arm["wb"])
+        t.record(arm, 1000, 1.0)
+    # rounds 3 and 6 explore (cadence 3), the rest exploit the
+    # incumbent — and with flat rates nothing is ever promoted
+    assert seen[0] == seen[1] == 8
+    assert seen[2] != 8
+    assert t.best() == {"wb": 8}
+
+
+def test_promotion_needs_margin_and_two_observations(monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE_EXPLORE", "2")
+    t = _tuner(margin=1.05)
+    # incumbent measured at 1000 edges/s
+    t.record({"wb": 8}, 1000, 1.0)
+    # first sight of a 3x-better challenger: NOT promoted (hysteresis —
+    # one lucky draw must not flip the configuration)
+    t.record({"wb": 4}, 3000, 1.0)
+    assert t.best() == {"wb": 8}
+    # second consistent observation clears the margin: promoted
+    t.record({"wb": 4}, 3000, 1.0)
+    assert t.best() == {"wb": 4}
+    # a challenger that does NOT clear 1.05x never wins
+    t.record({"wb": 2}, 3100, 1.0)
+    t.record({"wb": 2}, 3100, 1.0)
+    assert t.best() == {"wb": 4}
+    assert any(e["action"] == "promote" for e in t.timeline)
+
+
+def test_decisions_are_deterministic(monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE_EXPLORE", "2")
+
+    def drive():
+        t = _tuner(space={"wb": [2, 4, 8], "ingress": ["a", "b"]},
+                   initial={"wb": 8, "ingress": "a"})
+        picks = []
+        for i in range(8):
+            arm = t.next_round()
+            picks.append(json.dumps(arm, sort_keys=True))
+            t.record(arm, 1000 + 7 * i, 1.0)
+        return picks, t.best()
+
+    assert drive() == drive()
+
+
+def test_cache_round_trip_and_seed():
+    t = _tuner()
+    t.record({"wb": 8}, 1000, 1.0)
+    t.record({"wb": 4}, 4000, 1.0)
+    t.record({"wb": 4}, 4000, 1.0)
+    assert t.best() == {"wb": 4}
+    t.save()
+    # a new process (fresh tuner, same key): seeds from the cache
+    t2 = _tuner()
+    assert t2.best() == {"wb": 4}
+    assert t2.timeline[0]["action"] == "cache_seed"
+    # a cached arm OUTSIDE the current space is ignored
+    t3 = _tuner(space={"wb": [8, 16]}, initial={"wb": 16})
+    assert t3.best() == {"wb": 16}
+
+
+def test_cache_disabled_and_corrupt_cache(monkeypatch, tmp_path):
+    monkeypatch.setenv("GS_TUNE_CACHE", "0")
+    assert autotune.cache_path("cpu") == ""
+    t = _tuner()
+    t.record({"wb": 8}, 1000, 1.0)
+    t.save()  # no-op, no crash
+    cache_dir = tmp_path / "corrupt"
+    monkeypatch.setenv("GS_TUNE_CACHE", str(cache_dir))
+    os.makedirs(cache_dir)
+    with open(autotune.cache_path("x"), "w") as f:
+        f.write("{not json")
+    assert autotune.load_cached_best("any", "x") is None
+
+
+def test_state_dict_round_trip():
+    t = _tuner(space={"wb": [2, 4, 8]})
+    for i in range(5):
+        arm = t.next_round()
+        t.record(arm, 1000 + 100 * i, 1.0)
+    state = t.state_dict()
+    t2 = _tuner(space={"wb": [2, 4, 8]})
+    t2.load_state_dict(state)
+    assert t2.state_dict() == state
+    assert t2.best() == t.best()
+    # stale incumbent (space changed across a code change): dropped
+    t3 = _tuner(space={"wb": [16, 32]}, initial={"wb": 32})
+    t3.load_state_dict(state)
+    assert t3.best() == {"wb": 32}
+
+
+def test_initial_outside_space_rejected():
+    with pytest.raises(ValueError):
+        _tuner(initial={"wb": 3})
+
+
+# ----------------------------------------------------------------------
+# engine wiring: results invariant, knobs live
+# ----------------------------------------------------------------------
+def test_triangle_counts_identical_on_and_off(monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+    k0 = TriangleWindowKernel(edge_bucket=256, vertex_bucket=1024)
+    # the tuner engages only past one maximal chunk: size the stream
+    # off the kernel's own (possibly evidence-tuned) chunk depth
+    n_w = 2 * k0.MAX_STREAM_WINDOWS + 3
+    src, dst = _stream(n_w * 256, 1024)
+    legacy = k0._count_stream_device(src, dst)
+    monkeypatch.setenv("GS_AUTOTUNE", "1")
+    monkeypatch.setenv("GS_AUTOTUNE_EXPLORE", "2")
+    k = TriangleWindowKernel(edge_bucket=256, vertex_bucket=1024)
+    tuned = k._count_stream_device(src, dst)
+    assert tuned == legacy
+    assert k.tuner is not None and k.tuner._round > 0
+
+
+def test_autotune_off_keeps_legacy_path(monkeypatch):
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+    src, dst = _stream(24 * 256, 1024)
+    k = TriangleWindowKernel(edge_bucket=256, vertex_bucket=1024)
+    k._count_stream_device(src, dst)
+    # the tuner was never built: the static path ran untouched
+    assert getattr(k, "tuner", None) is None
+
+
+def test_pinned_knobs_freeze_tuner_dimensions():
+    k = TriangleWindowKernel(edge_bucket=256, vertex_bucket=1024,
+                             k_bucket=64, ingress="standard")
+    space = k._tuner_space()
+    assert space["kb"] == [k.kb]
+    assert space["ingress"] == ["standard"]
+    # unpinned: the ladder and both wire formats are in play
+    k2 = TriangleWindowKernel(edge_bucket=256, vertex_bucket=1024)
+    space2 = k2._tuner_space()
+    assert len(space2["kb"]) >= 1 and "compact" in space2["ingress"]
+
+
+def test_engine_summaries_identical_and_ckpt_round_trip(
+        monkeypatch, tmp_path):
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+    probe = StreamSummaryEngine(edge_bucket=512, vertex_bucket=2048)
+    n_w = 2 * probe.MAX_WINDOWS + 3
+    src, dst = _stream(n_w * 512, 2048, seed=7)
+    src32, dst32 = src.astype(np.int32), dst.astype(np.int32)
+    legacy = probe.process(src32, dst32)
+    monkeypatch.setenv("GS_AUTOTUNE", "1")
+    monkeypatch.setenv("GS_AUTOTUNE_EXPLORE", "2")
+    eng = StreamSummaryEngine(edge_bucket=512, vertex_bucket=2048)
+    assert eng.process(src32, dst32) == legacy
+    assert eng._tuner is not None
+    # the learned state rides the engine checkpoint
+    state = eng.state_dict()
+    assert "autotune" in state
+    eng2 = StreamSummaryEngine(edge_bucket=512, vertex_bucket=2048)
+    eng2.load_state_dict(state)
+    assert eng2._tuner.state_dict() == eng._tuner.state_dict()
+
+
+def test_driver_digests_identical_and_ckpt_round_trip(
+        monkeypatch, tmp_path):
+    src, dst = _stream(20 * 256, 2048, seed=11)
+
+    def digest(results):
+        h = hashlib.sha256()
+        for r in results:
+            for a in (r.vertex_ids, r.degrees, r.cc_labels,
+                      r.bipartite_odd):
+                if a is not None:
+                    h.update(np.ascontiguousarray(a).tobytes())
+            h.update(str(r.triangles).encode())
+        return h.hexdigest()
+
+    def run():
+        drv = StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=256, vertex_bucket=2048,
+            snapshot_tier="scan")
+        return digest(drv.run_arrays(src, dst)), drv
+
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+    d0, drv0 = run()
+    assert drv0._scan_tuner is None
+    monkeypatch.setenv("GS_AUTOTUNE", "1")
+    monkeypatch.setenv("GS_AUTOTUNE_EXPLORE", "2")
+    d1, drv1 = run()
+    assert d0 == d1
+    assert drv1._scan_tuner is not None
+    state = drv1.state_dict()
+    assert "autotune" in state
+    drv2 = StreamingAnalyticsDriver(
+        window_ms=0, edge_bucket=256, vertex_bucket=2048,
+        snapshot_tier="scan")
+    drv2.load_state_dict(state)
+    assert (drv2._scan_tuner.state_dict()
+            == drv1._scan_tuner.state_dict())

@@ -1,0 +1,172 @@
+"""PERF.json contract guards: the committed evidence files validate
+against the schema (tools/perf_schema.py), and the PERF.md renderer
+(tools/update_perf_md.py) round-trips a full fixture — so a new
+profiler section can't silently break the selection gates or the
+unattended end-of-window renderer."""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(REPO, "tools", name + ".py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+perf_schema = _load_tool("perf_schema")
+update_perf_md = _load_tool("update_perf_md")
+
+
+# ----------------------------------------------------------------------
+# schema: the committed files must stay valid
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("fname", [
+    "PERF.json", "PERF_cpu.json", "PERF_tpu.json"])
+def test_committed_perf_files_validate(fname):
+    path = os.path.join(REPO, fname)
+    if not os.path.exists(path):
+        pytest.skip("%s not committed" % fname)
+    with open(path) as f:
+        perf = json.load(f)
+    assert perf_schema.validate(perf) == []
+
+
+def test_schema_rejects_malformed_sections():
+    bad = {
+        "backend": "cpu",
+        "ingress_ab": {"not": "a list"},
+        "egress_ab": [{"probe": "driver_ab", "parity": True}],  # no speedup
+        "degradations": [{"from": "scan"}],        # missing to/window
+        "pipeline_stages": ["not-a-dict"],
+        "host_reduce_error": "not-a-dict",
+    }
+    errors = perf_schema.validate(bad)
+    joined = "\n".join(errors)
+    assert "ingress_ab" in joined
+    assert "egress_ab" in joined and "speedup" in joined
+    assert "degradations" in joined
+    assert "pipeline_stages" in joined
+    assert "host_reduce_error" in joined
+    assert perf_schema.validate([]) != []       # top level must be dict
+    assert perf_schema.validate({"backend": 3})  # backend must be str
+
+
+def test_schema_allows_unknown_sections():
+    assert perf_schema.validate(
+        {"backend": "cpu", "brand_new_section": [{"x": 1}]}) == []
+
+
+# ----------------------------------------------------------------------
+# renderer round-trip on a full fixture
+# ----------------------------------------------------------------------
+FIXTURE = {
+    "backend": "cpu",
+    "device": "TFRT_CPU_0",
+    "roofline": {
+        "peaks": {"hw": "v5e", "bf16_tflops": 197, "hbm_gbps": 819},
+        "rows": [{"program": "tri_stream", "ms": 1.5,
+                  "gflops_achieved": 10.0, "mfu_vs_bf16_peak": 0.01,
+                  "gbps_achieved": 5.0, "hbm_frac_of_peak": 0.01,
+                  "bound": "hbm",
+                  "arith_intensity_flops_per_byte": 2.0}],
+    },
+    "trace": {"windows": 16, "edge_bucket": 32768,
+              "dispatch_wall_ms": 100.0, "trace_dir": "logs/trace",
+              "top_ops": [{"op": "sort", "total_ms": 5.0, "calls": 2}]},
+    "host_stream": [{"edge_bucket": 8192, "parity": True,
+                     "host_edges_per_s": 2, "device_edges_per_s": 1,
+                     "host_vs_device": 2.0}],
+    "pipeline_stages": [{"engine": "triangle", "edge_bucket": 32768,
+                         "ingress": "standard", "workers": 4,
+                         "prep_ms_per_chunk": 1.0,
+                         "h2d_ms_per_chunk": 2.0,
+                         "compute_ms_per_chunk": 3.0,
+                         "pipelined_edges_per_s": 10,
+                         "sync_edges_per_s": 5,
+                         "pipeline_speedup": 2.0, "parity": True}],
+    "ingress_probes": [{"probe": "dispatch_latency",
+                        "round_trip_s": 0.2}],
+    "ingress_ab": [{"probe": "stream_ab", "parity": True,
+                    "num_edges": 100, "std_edges_per_s": 1,
+                    "compact_edges_per_s": 2, "speedup": 2.0,
+                    "speedup_worst": 1.8, "speedup_best": 2.2}],
+    "egress_ab": [{"probe": "driver_ab", "parity": True,
+                   "eb": 32768, "vb": 65536,
+                   "full_edges_per_s": 1, "delta_edges_per_s": 2,
+                   "speedup": 2.0, "speedup_worst": 1.9,
+                   "speedup_best": 2.1}],
+    "autotune": [{"engine": "triangle_stream", "edge_bucket": 32768,
+                  "parity": True, "static_edges_per_s": 1,
+                  "tuned_cold_edges_per_s": 2,
+                  "tuned_seeded_edges_per_s": 3,
+                  "seeded_vs_static": 3.0,
+                  "chosen": {"wb": 64, "kb": 32,
+                             "ingress": "standard"}}],
+    "degradations": [{"section": "driver", "from": "scan",
+                      "to": "native", "window": 5, "reason": "t"}],
+    "sharded": {"collectives": {
+        "config": {"n": 8, "vb": 65536, "kb": 32, "cap": 4096},
+        "backend": "cpu-virtual-mesh", "note": "modeled",
+        "rows": [{"collective": "psum",
+                  "modeled_ici_bytes_per_chip": 1024,
+                  "modeled_ms_v5e_ici": 0.01,
+                  "measured_ms_cpu_mesh": 0.5}]}},
+}
+
+
+def test_fixture_passes_schema():
+    assert perf_schema.validate(FIXTURE) == []
+
+
+def test_render_covers_every_new_section():
+    block = update_perf_md.render(FIXTURE)
+    assert update_perf_md.MARK_BEGIN in block
+    assert update_perf_md.MARK_END in block
+    for needle in ("d2h egress A/B", "Online dispatch autotuner",
+                   "driver_ab", "triangle_stream",
+                   "wb=64", "DEGRADED RUN", "Roofline",
+                   "Ingress pipeline per-stage timing"):
+        assert needle in block, needle
+
+
+def test_update_perf_md_round_trips_idempotently(tmp_path):
+    perf_path = str(tmp_path / "PERF.json")
+    md_path = str(tmp_path / "PERF.md")
+    with open(perf_path, "w") as f:
+        json.dump(FIXTURE, f)
+    with open(md_path, "w") as f:
+        f.write("# PERF\n\nhand-written preamble\n\n%s\nstale\n%s\n"
+                "hand-written tail\n" % (update_perf_md.MARK_BEGIN,
+                                         update_perf_md.MARK_END))
+    update_perf_md.main(perf_path, md_path)
+    with open(md_path) as f:
+        once = f.read()
+    assert "hand-written preamble" in once
+    assert "hand-written tail" in once
+    assert "stale" not in once
+    assert "Online dispatch autotuner" in once
+    update_perf_md.main(perf_path, md_path)  # idempotent
+    with open(md_path) as f:
+        assert f.read() == once
+
+
+def test_update_perf_md_appends_block_when_markers_absent(tmp_path):
+    perf_path = str(tmp_path / "PERF.json")
+    md_path = str(tmp_path / "PERF.md")
+    with open(perf_path, "w") as f:
+        json.dump(FIXTURE, f)
+    with open(md_path, "w") as f:
+        f.write("# PERF\n")
+    update_perf_md.main(perf_path, md_path)
+    with open(md_path) as f:
+        out = f.read()
+    assert out.startswith("# PERF")
+    assert update_perf_md.MARK_BEGIN in out

@@ -184,6 +184,98 @@ def leg_engine(src, dst, eb: int, vb: int, num_w: int,
     }
 
 
+def leg_autotune(path: str, eb: int, num_w: int, workdir: str) -> dict:
+    """The autotune leg: the driver's SCAN tier with the online tuner
+    live (GS_AUTOTUNE=1, hermetic cache in the workdir), killed
+    mid-stream and resumed — proving (a) results stay bit-identical to
+    the fault-free tuned run, and (b) the TUNING STATE round-trips the
+    checkpoint: the resumed driver's tuner state equals what the
+    checkpoint carried, so a resumed stream keeps its learned
+    configuration instead of re-exploring from scratch."""
+    from gelly_streaming_tpu.utils import checkpoint as ckpt_mod
+
+    env_prev = {k: os.environ.get(k)
+                for k in ("GS_AUTOTUNE", "GS_TUNE_CACHE",
+                          "GS_STAGE_TIMEOUT_S")}
+    os.environ["GS_AUTOTUNE"] = "1"
+    os.environ["GS_TUNE_CACHE"] = workdir
+    # this leg proves the tuning-state round-trip, not the watchdog
+    # (leg A owns that): the chaos 1 s deadline would demote the scan
+    # tier under host load and leave the tuner measuring nothing
+    os.environ["GS_STAGE_TIMEOUT_S"] = "30"
+    piece = 1 << 20
+
+    def make():
+        return StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=eb, vertex_bucket=1024,
+            analytics=("degrees", "cc", "bipartite", "triangles"),
+            snapshot_tier="scan")
+
+    try:
+        baseline = [
+            _digest(r)
+            for r in make().stream_file(path, chunk_bytes=piece)]
+        assert len(baseline) == num_w, (len(baseline), num_w)
+
+        ckpt = os.path.join(workdir, "autotune.npz")
+        drv = make()
+        drv.enable_auto_checkpoint(ckpt, every_n_windows=4)
+        got = {}
+        killed = False
+        try:
+            with faults.inject(faults.FaultSpec(
+                    site="dispatch", on_call=6, fatal=True)) as plan:
+                for r in drv.stream_file(path, chunk_bytes=piece):
+                    got[_digest(r)[0]] = _digest(r)
+        except faults.InjectedFault:
+            killed = True
+        if not killed:
+            raise SystemExit("chaos autotune leg: the kill never "
+                             "fired (fired=%r)" % (plan.fired,))
+
+        drv2 = make()
+        if not drv2.try_resume(ckpt):
+            raise SystemExit("chaos autotune leg: no resumable "
+                             "checkpoint after the kill")
+        # the tuning state must have ridden the checkpoint bit-for-bit
+        saved_state, _used = ckpt_mod.load_latest(ckpt)
+        if "autotune" not in saved_state:
+            raise SystemExit("chaos autotune leg: checkpoint carries "
+                             "no autotune state")
+        restored = drv2._scan_tuner.state_dict()
+        if restored != saved_state["autotune"]:
+            raise SystemExit(
+                "chaos autotune leg: resumed tuner state diverged "
+                "from the checkpointed one:\n%r\nvs\n%r"
+                % (restored, saved_state["autotune"]))
+        if int(restored.get("round", 0)) < 1:
+            raise SystemExit(
+                "chaos autotune leg: the tuner never recorded a "
+                "round before the checkpoint — the leg is not "
+                "exercising the scheduler (demoted tier? deadline?)")
+        resumed_from = drv2.windows_done
+        for r in drv2.stream_file(path, chunk_bytes=piece,
+                                  resume=resumed_from > 0):
+            got[_digest(r)[0]] = _digest(r)
+        final = [got[k] for k in sorted(got)]
+        if final != baseline:
+            raise SystemExit(
+                "chaos autotune leg DIVERGED from the fault-free run")
+        return {
+            "windows": num_w,
+            "resumed_from_window": resumed_from,
+            "tuner_rounds_at_resume": int(restored.get("round", 0)),
+            "tuner_incumbent": restored.get("incumbent"),
+            "parity": True,
+        }
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--edges", type=int, default=524288)
@@ -213,6 +305,9 @@ def main():
         path = os.path.join(workdir, "edges.txt")
         _write_stream(path, src, dst)
         a = leg_driver(path, args.eb, num_w, workdir)
+        # autotune leg: scan tier + live tuner, kill → resume, tuning
+        # state must round-trip the checkpoint bit-for-bit
+        at = leg_autotune(path, args.eb, num_w, workdir)
         # leg B runs a right-sized twin stream: the fused scan's CPU
         # cold-compile + materialize must FIT the 1 s chaos deadline
         # (at vb=65536 the first chunk's finalize legitimately
@@ -242,7 +337,7 @@ def main():
         "edges": args.edges, "edge_bucket": args.eb,
         "vertices": args.vertices,
         "knobs": KNOBS,
-        "driver_leg": a, "engine_leg": b,
+        "driver_leg": a, "engine_leg": b, "autotune_leg": at,
         "fault_classes_fired": sorted(classes),
         "demotions": resilience.demotion_events(),
         "parity": True,

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Lightweight schema check for PERF.json (and the per-backend
+archives PERF_<backend>.json).
+
+The committed evidence file drives the library's kernel
+auto-selection (ops/triangles._load_matching_perf and friends) AND
+the PERF.md renderer (tools/update_perf_md.py). A malformed section —
+a dict where a row list belongs, a parity-true row without a speedup,
+a degradation event missing its tiers — silently disables a selection
+or crashes the unattended renderer at the END of a chip window, which
+is exactly when raw output is lost. This validator is the cheap
+tier-1 guard (tests/test_perf_tooling.py) that new profiler sections
+can't break the contract unnoticed.
+
+Usage: python tools/perf_schema.py [PERF.json ...]   (repo default)
+Exit 0 = every file clean; errors list file:section:problem lines.
+
+Forward-compatible by design: UNKNOWN top-level keys are allowed
+(new sections land before the validator learns them); only the shape
+of KNOWN sections is enforced.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# sections whose value must be a list of dict rows, with per-row
+# REQUIRED keys (value None = key must exist, any type)
+LIST_SECTIONS = {
+    "intersect": (),          # dict OR list historically: checked below
+    "window": ("edge_bucket",),
+    "host_stream": ("edge_bucket", "parity"),
+    "host_reduce": ("edge_bucket", "name", "parity"),
+    "host_snapshot": ("edge_bucket", "parity"),
+    "ingress_ab": ("probe", "parity"),
+    "egress_ab": ("probe", "parity"),
+    "autotune": ("engine", "parity"),
+    "pipeline_stages": ("engine", "edge_bucket"),
+    "chunk_deep": ("edge_bucket",),
+    "compile_probe": ("program", "slots", "ok"),
+    "compile_probe_scan": ("program", "slots", "ok"),
+    "degradations": ("from", "to", "window"),
+    "ingress_probes": ("probe",),
+}
+
+# A/B sections whose parity-true rows must claim a positive speedup
+# (the adoption gates divide by it; rows_clear_bar rejects otherwise)
+_AB_SECTIONS = ("ingress_ab", "egress_ab")
+
+
+def _check_rows(name: str, rows, errors) -> None:
+    if not isinstance(rows, list):
+        errors.append("%s: expected a list of rows, got %s"
+                      % (name, type(rows).__name__))
+        return
+    for i, row in enumerate(rows):
+        if not isinstance(row, dict):
+            errors.append("%s[%d]: expected a dict row, got %s"
+                          % (name, i, type(row).__name__))
+            continue
+        for key in LIST_SECTIONS.get(name, ()):
+            if key not in row:
+                errors.append("%s[%d]: missing required key %r"
+                              % (name, i, key))
+        if name in _AB_SECTIONS and row.get("parity") is True:
+            sp = row.get("speedup")
+            if not isinstance(sp, (int, float)) or sp <= 0:
+                errors.append(
+                    "%s[%d]: parity-true row needs a positive "
+                    "'speedup' (got %r)" % (name, i, sp))
+
+
+def validate(perf) -> list:
+    """Error strings for one parsed PERF dict; empty = clean."""
+    errors = []
+    if not isinstance(perf, dict):
+        return ["top level: expected a dict, got %s"
+                % type(perf).__name__]
+    if not isinstance(perf.get("backend"), str):
+        errors.append("top level: 'backend' must be a string "
+                      "(got %r)" % (perf.get("backend"),))
+    for name, val in perf.items():
+        if name.endswith("_error"):
+            if not (isinstance(val, dict) and "error" in val):
+                errors.append("%s: failed-section stub must be a dict "
+                              "with an 'error' key" % name)
+            continue
+        if name == "intersect":
+            # historically a single dict row; a list is also accepted
+            if not isinstance(val, (dict, list)):
+                errors.append("intersect: expected dict or list")
+            continue
+        if name in LIST_SECTIONS:
+            _check_rows(name, val, errors)
+    return errors
+
+
+def main(paths=None) -> int:
+    paths = paths or [os.path.join(REPO, "PERF.json")]
+    rc = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                perf = json.load(f)
+        except (OSError, ValueError) as e:
+            print("%s: unreadable (%s)" % (path, e))
+            rc = 1
+            continue
+        errors = validate(perf)
+        if errors:
+            rc = 1
+            for e in errors:
+                print("%s: %s" % (os.path.basename(path), e))
+        else:
+            print("%s: ok (%d top-level keys)"
+                  % (os.path.basename(path), len(perf)))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:] or None))

@@ -1018,6 +1018,96 @@ def section_ingress_ab(results: dict) -> None:
     results["ingress_ab"] = ab
 
 
+def section_egress_ab(results: dict) -> None:
+    """d2h egress-format A/B (ops/delta_egress.py) — the committed
+    evidence `resolve_egress` reads, via the same probes as the
+    standalone tools/egress_ab.py (exact parity asserted, median-of-3
+    with dispersion committed). GS_AUTOTUNE is already pinned off for
+    this child, so the egress lever is measured in isolation."""
+    import jax
+
+    from tools.egress_ab import driver_ab, reduce_ab
+
+    rows = []
+    edges = int(os.environ.get("GS_AB_EDGES", 524_288))
+    driver_ab(jax, edges, rows)
+    reduce_ab(jax, edges, rows)
+    results["egress_ab"] = rows
+
+
+def section_autotune(results: dict) -> None:
+    """Online dispatch-tuner evidence (ops/autotune.py): the triangle
+    stream's device path static vs tuned-from-cold vs tuned-seeded
+    (the second run starts from the first's persisted optimum), with
+    the chosen arm and decision timeline committed — so the claim
+    'the scheduler converges to a configuration no slower than the
+    best static row' is a row, not an assertion."""
+    import tempfile
+    import time
+
+    import numpy as np
+
+    from bench import make_stream
+    from gelly_streaming_tpu.ops import segment as seg_ops
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    eb, vb = 32768, 65536
+    # the tuner engages only past one maximal dispatch chunk; give it
+    # several rounds' worth of stream (≥4 chunks at the class default)
+    edges = int(os.environ.get("GS_AUTOTUNE_EDGES", 8_388_608))
+    src, dst = make_stream(edges, vb)
+
+    def timed(fn):
+        t0 = time.perf_counter()
+        out = fn()
+        return out, time.perf_counter() - t0
+
+    os.environ["GS_AUTOTUNE"] = "0"
+    k0 = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+    seg_ops.warm_stream_buckets(k0)
+    base_counts = k0._count_stream_device(src, dst)  # warm run
+    _, static_s = timed(lambda: k0._count_stream_device(src, dst))
+
+    os.environ["GS_AUTOTUNE"] = "1"
+    prev_cache = os.environ.get("GS_TUNE_CACHE")
+    with tempfile.TemporaryDirectory(prefix="gs-tune-") as td:
+        os.environ["GS_TUNE_CACHE"] = td  # cold, section-local cache
+        try:
+            k1 = TriangleWindowKernel(edge_bucket=eb,
+                                      vertex_bucket=vb)
+            counts1, cold_s = timed(
+                lambda: k1._count_stream_device(src, dst))
+            # a second kernel = a second process: seeds from the cache
+            k2 = TriangleWindowKernel(edge_bucket=eb,
+                                      vertex_bucket=vb)
+            counts2, seeded_s = timed(
+                lambda: k2._count_stream_device(src, dst))
+        finally:
+            if prev_cache is None:
+                os.environ.pop("GS_TUNE_CACHE", None)
+            else:
+                os.environ["GS_TUNE_CACHE"] = prev_cache
+    parity = base_counts == counts1 == counts2
+    t2 = getattr(k2, "tuner", None)
+    t1 = getattr(k1, "tuner", None)
+    summary = t2.summary() if t2 else {}
+    row = {
+        "engine": "triangle_stream",
+        "edge_bucket": eb, "vertex_bucket": vb, "num_edges": edges,
+        "static_edges_per_s": round(edges / static_s),
+        "tuned_cold_edges_per_s": round(edges / cold_s),
+        "tuned_seeded_edges_per_s": round(edges / seeded_s),
+        "seeded_vs_static": round(static_s / seeded_s, 3),
+        "parity": bool(parity),
+        "chosen": summary.get("chosen"),
+        "rounds": summary.get("rounds"),
+        "promotions": summary.get("promotions"),
+        "cold_timeline": (t1.summary().get("timeline", [])
+                          if t1 else []),
+    }
+    results["autotune"] = [row]
+
+
 def section_host_snapshot(results: dict) -> None:
     """Batched snapshot-analytics tiers: the driver's device scan vs
     the C++ carried union-find (native.snapshot_windows) — the
@@ -1254,6 +1344,8 @@ def section_compile_probe_scan(results: dict) -> None:
 SECTIONS = {
     "intersect": section_intersect,
     "ingress_ab": section_ingress_ab,
+    "egress_ab": section_egress_ab,
+    "autotune": section_autotune,
     "window": section_window,
     "host_stream": section_host_stream,
     "pipeline_stages": section_pipeline,
@@ -1275,6 +1367,12 @@ def run_section_child(name: str) -> None:
     line — the FULL results dict, so auxiliary keys a section records
     next to its own (e.g. ingress_ab's `ingress_probes`) reach the
     orchestrator instead of vanishing with the child."""
+    if name != "autotune":
+        # measurement sections pin the STATIC configuration: the online
+        # tuner (ops/autotune) changing dispatch knobs mid-rep would
+        # make sweep/A-B rows measure a moving target. The `autotune`
+        # section measures the tuner itself and re-enables it.
+        os.environ["GS_AUTOTUNE"] = "0"
     import jax
 
     from gelly_streaming_tpu.utils import resilience
@@ -1389,6 +1487,13 @@ def main():
             except (OSError, ValueError):
                 arch = {}
             arch.update(merged)
+            # a section that succeeded THIS run clears its stale
+            # failure stub from the archive too — the PERF.json merge
+            # above already does; without this the archive keeps a
+            # dead <name>_error beside the good rows forever
+            for k in list(merged):
+                if not k.endswith("_error"):
+                    arch.pop(k + "_error", None)
             with open(arch_path, "w") as f:
                 json.dump(arch, f, indent=2)
         wrote[0] = path
