@@ -498,6 +498,19 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
         }
     else:
         row["autotune"] = {"enabled": _autotune.enabled()}
+    # flight-recorder provenance (utils/telemetry): the A/B
+    # measurement sections above run DISARMED by default
+    # (GS_TELEMETRY=0 — the zero-overhead contract keeps the headline
+    # honest); an operator who arms it gets the armed row labeled,
+    # with its trace ID and the top span aggregates riding along
+    from gelly_streaming_tpu.utils import telemetry as _telemetry
+
+    if _telemetry.enabled():
+        row["telemetry"] = {"armed": True,
+                            "trace": _telemetry.trace_id(),
+                            "spans": _telemetry.summary(top=8)}
+    else:
+        row["telemetry"] = {"armed": False}
     print(json.dumps(row), flush=True)
 
 

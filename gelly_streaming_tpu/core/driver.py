@@ -46,6 +46,7 @@ from ..ops import unionfind
 from ..utils import checkpoint
 from ..utils import faults
 from ..utils import resilience
+from ..utils import telemetry
 from ..utils.interning import make_interner, parallel_intern_arrays
 from ..utils.tracing import StepTimer
 
@@ -1077,18 +1078,17 @@ class StreamingAnalyticsDriver:
                     decided[pos] = (take, arm)
             return decided[pos]
 
-        meas = None  # (arm, edges, t0) of the chunk last dispatched
+        meas = None  # (arm, edges, stopwatch) of the last dispatch
 
         def _meas_flush():
             nonlocal meas
             if meas is not None and tuner is not None \
                     and not ingress_pipeline.forced_sync_active():
-                arm, edges, t0 = meas
+                arm, edges, sw = meas
                 if arm is not None:
-                    import time as _time
-
-                    tuner.record(arm, edges,
-                                 _time.perf_counter() - t0)
+                    # the telemetry stopwatch closes the dispatch-to-
+                    # dispatch round (recording the span when armed)
+                    tuner.record(arm, edges, sw.stop(edges=edges))
             meas = None
 
         def _chunk_loop():
@@ -1177,11 +1177,11 @@ class StreamingAnalyticsDriver:
                 # skipped — its amortization would drag the arm's EMA
                 if tuner is not None and cur_arm is not None \
                         and len(chunk) == min(cur_arm["wb"], num_w):
-                    import time as _time
-
                     meas = (cur_arm,
                             sum(len(s) for _w, s, _d, _n in chunk),
-                            _time.perf_counter())
+                            telemetry.stopwatch("driver.scan_round",
+                                                window=at,
+                                                wb=cur_arm["wb"]))
                 with self._step("snapshot_scan",
                                 sum(len(s) for _w, s, _d, _n in chunk)):
                     # async dispatch: returns device arrays without
@@ -1451,8 +1451,14 @@ class StreamingAnalyticsDriver:
             self._tri_pending = None
 
     def _step(self, name: str, num_records: int):
-        return (self.timer.step(name, num_records) if self.timer
-                else contextlib.nullcontext())
+        """Driver step timing: through the StepTimer when tracing is
+        on (it forwards to the flight recorder), straight to a
+        telemetry span otherwise — the recorder sees the driver's
+        dispatch/materialize decomposition whether or not per-op
+        tracing was requested (a no-op stopwatch when disarmed)."""
+        if self.timer:
+            return self.timer.step(name, num_records)
+        return telemetry.span("step." + name, records=num_records)
 
     def _vertex_ids(self, nv: int) -> np.ndarray:
         """Slot → external-id table; slots are assigned once, so the
@@ -1728,6 +1734,11 @@ class StreamingAnalyticsDriver:
                 f"checkpoint {path!r} is corrupt; resumed from the "
                 f"rotated previous generation {used!r}")
         self.load_state_dict(state)
+        # durable stamp: pairs with the pre-kill spans under the
+        # process's one trace ID, so a crash/resume reads as a single
+        # timeline in the run ledger (asserted by tools/chaos_run.py)
+        telemetry.event("resume", durable=True, component="driver",
+                        path=used, windows_done=self.windows_done)
         return True
 
     def state_dict(self) -> dict:

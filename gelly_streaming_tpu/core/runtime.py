@@ -133,17 +133,23 @@ class Executor:
         else:
             # exclusive per-operator timing: subtract time spent
             # evaluating parents inside _apply
-            import time as _t
+            from ..utils import telemetry
 
-            t0 = _t.perf_counter()
+            sw = telemetry.stopwatch()  # unnamed: pure measurement
             self._timing_stack.append(0.0)
             records = self._apply(node, overrides, cache)
-            elapsed = _t.perf_counter() - t0
+            elapsed = sw.stop()
             child_time = self._timing_stack.pop()
             if self._timing_stack:
                 self._timing_stack[-1] += elapsed
             timer.add(f"{node.kind}#{node.id}", elapsed - child_time,
                       len(records))
+            # the flight-recorder span carries the EXCLUSIVE time (the
+            # inclusive interval would double-count parents that
+            # already recorded their own spans via this same path)
+            telemetry.record_span(f"op.{node.kind}", sw.t0,
+                                  elapsed - child_time, node=node.id,
+                                  records=len(records))
         memo[node.id] = records
         return records
 

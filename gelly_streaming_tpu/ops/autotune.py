@@ -49,6 +49,8 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from ..utils import telemetry
+
 _DEF_MARGIN = 1.05        # the repo-wide measured-adoption bar
 _DEF_EXPLORE_PERIOD = 3   # explore every 3rd measurement round
 _EMA_ALPHA = 0.5          # smoothing of per-arm measured rates
@@ -234,6 +236,15 @@ class DispatchTuner:
             "edges_per_s": None if rate is None else round(rate)})
         if len(self.timeline) > _TIMELINE_CAP:
             del self.timeline[:len(self.timeline) - _TIMELINE_CAP]
+        # every scheduler decision is a structured flight-recorder
+        # event (promotions durably: a mid-stream configuration change
+        # is exactly what a post-mortem must be able to date)
+        telemetry.event("autotune." + action,
+                        durable=action == "promote",
+                        key=self.key, round=self._round,
+                        arm=json.dumps(arm, sort_keys=True),
+                        edges_per_s=None if rate is None
+                        else round(rate))
 
     # -- protocol ------------------------------------------------------
     def next_round(self) -> dict:

@@ -41,7 +41,10 @@ Stages, per chunk of windows:
 Per-stage wall time accumulates in a `StageTimers` (prep/h2d/compute
 ms per chunk) that tools/profile_kernels.py commits to PERF.json, so
 the next tunnel window can decompose the chip-side wall without new
-instrumentation.
+instrumentation. With the flight recorder armed (utils/telemetry,
+GS_TELEMETRY=1) each chunk additionally records a correlated span
+tree — an `ingress.chunk` span with prep/h2d/dispatch/finalize child
+spans, worker-side stages included — into the run ledger.
 
 Env knobs:
   GS_STREAM_PREFETCH=0  — force the fully synchronous single-threaded
@@ -92,6 +95,7 @@ from typing import Callable, Iterable, List, Optional
 
 from ..utils import faults
 from ..utils import resilience
+from ..utils import telemetry
 from ..utils.resilience import StageFailed, StageTimeout
 
 _MAX_DEFAULT_WORKERS = 4
@@ -258,6 +262,17 @@ def _mark(cell: Optional[dict], stage: str) -> None:
         cell["stage"] = stage
 
 
+def _span_cell(cell: Optional[dict], item):
+    """(parent span id, chunk correlation id) of a worker stage: the
+    chunk ctx rides the worker cell (thread-local span nesting cannot
+    cross the pool); without one, the item's own key still correlates
+    the stage records of one chunk."""
+    ctx = cell.get("tctx") if cell else None
+    if ctx is not None:
+        return ctx["sid"], ctx["chunk"]
+    return None, telemetry.chunk_key(item)
+
+
 def _timed_prep(prep: Callable, item, timers: Optional[StageTimers],
                 cell: Optional[dict] = None):
     """Worker-side prep wrapper: times the call and converts a failure
@@ -275,8 +290,12 @@ def _timed_prep(prep: Callable, item, timers: Optional[StageTimers],
         raise PrepError(
             "ingress prep stage failed for chunk %r:\n%s"
             % (item, traceback.format_exc())) from e
+    dt = time.perf_counter() - t0
     if timers is not None:
-        timers.add("prep", time.perf_counter() - t0)
+        timers.add("prep", dt)
+    par, ck = _span_cell(cell, item)
+    telemetry.record_span("ingress.prep", t0, dt, parent=par,
+                          chunk=ck)
     return out
 
 
@@ -295,8 +314,11 @@ def _prep_then_h2d(prep: Callable, h2d: Callable, item,
         raise PrepError(
             "ingress h2d stage failed for chunk %r:\n%s"
             % (item, traceback.format_exc())) from e
+    dt = time.perf_counter() - t0
     if timers is not None:
-        timers.add("h2d", time.perf_counter() - t0)
+        timers.add("h2d", dt)
+    par, ck = _span_cell(cell, item)
+    telemetry.record_span("ingress.h2d", t0, dt, parent=par, chunk=ck)
     _mark(cell, "done")
     return dev
 
@@ -366,7 +388,11 @@ def _guarded_prep_h2d(prep: Callable, h2d: Callable, item,
                 first_future.result, cell, timeout,
                 cell.get("submitted", t0))
         elif timeout > 0:
-            cell, box, done = {}, {}, threading.Event()
+            # retry attempts keep the chunk-span correlation of the
+            # pooled first attempt (telemetry): same parent, so the
+            # ledger shows the retries under one chunk
+            cell = {"tctx": (first_cell or {}).get("tctx")}
+            box, done = {}, threading.Event()
 
             def _runner(cell=cell, box=box, done=done):
                 try:
@@ -388,7 +414,7 @@ def _guarded_prep_h2d(prep: Callable, h2d: Callable, item,
             ok, res, stage = _await_attempt(done.wait, _outcome, cell,
                                             timeout, t0)
         else:  # retries without a deadline: run inline
-            cell = {}
+            cell = {"tctx": (first_cell or {}).get("tctx")}
             try:
                 return _prep_then_h2d(prep, h2d, item, timers, cell)
             except Exception as e:
@@ -414,6 +440,10 @@ def _guarded_prep_h2d(prep: Callable, h2d: Callable, item,
                 "%s stage of chunk %r failed after %d attempt(s): %s"
                 % (last_stage, item, len(attempts), res),
                 last_stage, item, attempts) from res
+        telemetry.event("stage_retry", stage=last_stage,
+                        chunk=telemetry.chunk_key(item),
+                        attempt=attempt + 1,
+                        outcome=attempts[-1]["outcome"])
         time.sleep(backoff * (2 ** attempt))
 
 
@@ -468,11 +498,17 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
     """
     items = list(items)
     pool = prep_pool() if len(items) > 1 else None
-    pending = None  # (item, raw outputs) one chunk behind dispatch
+    pending = None  # (item, raw, chunk ctx) one chunk behind dispatch
     guard = resilience.guard_active()
     futures = ()
 
-    def _finalize(item, raw):
+    def _ctx(it):
+        # chunk span handle (telemetry): stage spans of this chunk —
+        # including the pool worker's prep/h2d — parent to it; closed
+        # when the chunk's finalize lands. None when disarmed.
+        return telemetry.chunk_ctx(telemetry.chunk_key(it))
+
+    def _finalize(item, raw, tctx=None):
         t0 = time.perf_counter()
 
         def _call():
@@ -487,32 +523,42 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
             resilience.call_guarded("finalize", item, _call, retries=0)
         else:
             _call()
+        dt = time.perf_counter() - t0
         if timers is not None:
-            timers.add("compute", time.perf_counter() - t0)
+            timers.add("compute", dt)
             timers.chunks += 1
+        par, ck = _span_cell({"tctx": tctx}, item)
+        telemetry.record_span("ingress.finalize", t0, dt, parent=par,
+                              chunk=ck)
+        telemetry.close_chunk(tctx)
 
-    def _consume(item, dev):
+    def _consume(item, dev, tctx=None):
         nonlocal pending
 
         def _call():
             faults.fire("dispatch")
             return dispatch(dev)
 
+        t0 = time.perf_counter()
         # dispatch is retries=0 too: engines fold the chunk into a
         # device-resident carry inside it, so re-running would
         # double-fold the chunk
         raw = (resilience.call_guarded("dispatch", item, _call,
                                        retries=0)
                if guard else _call())
+        par, ck = _span_cell({"tctx": tctx}, item)
+        telemetry.record_span("ingress.dispatch", t0,
+                              time.perf_counter() - t0, parent=par,
+                              chunk=ck)
         if pending is not None:
             done_chunk, pending = pending, None
             _finalize(*done_chunk)
-        pending = (item, raw)
+        pending = (item, raw, tctx)
 
     def _submit(it):
         # `submitted` anchors the queue-wait deadline: a task no
         # wedged-pool worker ever picks up must still time out
-        cell = {"submitted": time.perf_counter()}
+        cell = {"submitted": time.perf_counter(), "tctx": _ctx(it)}
         return (it, cell,
                 pool.submit(_prep_then_h2d, prep, h2d, it, timers,
                             cell))
@@ -520,10 +566,14 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
     try:
         if pool is None:
             for item in items:
-                dev = (_guarded_prep_h2d(prep, h2d, item, timers)
+                tctx = _ctx(item)
+                cell = {"tctx": tctx}
+                dev = (_guarded_prep_h2d(prep, h2d, item, timers,
+                                         first_cell=cell)
                        if guard
-                       else _prep_then_h2d(prep, h2d, item, timers))
-                _consume(item, dev)
+                       else _prep_then_h2d(prep, h2d, item, timers,
+                                           cell))
+                _consume(item, dev, tctx)
         else:
             from collections import deque
 
@@ -544,7 +594,7 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
                 if nxt < len(items):
                     futures.append(_submit(items[nxt]))
                     nxt += 1
-                _consume(item, dev)
+                _consume(item, dev, cell.get("tctx"))
     except Exception:
         # drain in-flight device work before surfacing the failure:
         # the previous chunk was already dispatched, so its outputs

@@ -43,6 +43,7 @@ from . import segment as seg_ops
 from . import triangles as tri_ops
 from . import unionfind
 from ..utils import checkpoint
+from ..utils import telemetry
 
 
 def _build_scan(eb: int, vb: int, kb: int):
@@ -214,6 +215,11 @@ class SummaryEngineBase:
                 f"checkpoint {path!r} is corrupt; resumed from the "
                 f"rotated previous generation {used!r}")
         self.load_state_dict(state)
+        # durable stamp: the resume point pairs with the pre-kill
+        # spans under the process's one trace ID, so a crash/resume
+        # reads as a single timeline in the run ledger
+        telemetry.event("resume", durable=True, component="engine",
+                        path=used, windows_done=self.windows_done)
         return True
 
     def resume_offset(self) -> int:
@@ -486,8 +492,6 @@ class SummaryEngineBase:
         measured edges/s fed back to the tuner. Summaries are
         identical at every arm; under forced_sync the tuner freezes
         (see ingress_pipeline.forced_sync_active)."""
-        import time as _time
-
         from . import autotune
 
         tuner = self._ensure_tuner()
@@ -508,16 +512,19 @@ class SummaryEngineBase:
                                              "fused summary scan")
                 validated = True
             take = min(num_w - at0, round_len * wb)
-            t0 = _time.perf_counter()
-            self._run_window_rounds(src, dst, at0, at0 + take, wb,
-                                    fmt == "compact", None,
-                                    base, staged, out)
+            # telemetry span doubles as the round stopwatch (same
+            # perf_counter measurement disarmed)
+            with telemetry.span("fused_scan.round", window=base + at0,
+                                wb=wb, ingress=fmt,
+                                edges=take * self.eb) as sp:
+                self._run_window_rounds(src, dst, at0, at0 + take, wb,
+                                        fmt == "compact", None,
+                                        base, staged, out)
             # full rounds (or a whole call smaller than one) only: a
             # long call's ragged tail would drag the arm's EMA with
             # tail economics
             if not freeze and take == min(round_len * wb, num_w):
-                tuner.record(arm, take * self.eb,
-                             _time.perf_counter() - t0)
+                tuner.record(arm, take * self.eb, sp.elapsed)
             at0 += take
         if not freeze:
             tuner.save()

@@ -35,6 +35,7 @@ import numpy as np
 
 from . import ingress_pipeline
 from . import segment as seg_ops
+from ..utils import telemetry
 
 DENSE_LIMIT = 2048
 
@@ -1057,8 +1058,6 @@ class TriangleWindowKernel:
         dispatch economics change. Under forced_sync (the bench's A/B
         lever) the tuner FREEZES — the incumbent runs and nothing is
         recorded."""
-        import time as _time
-
         from . import autotune
         from . import compact_ingress
 
@@ -1098,16 +1097,19 @@ class TriangleWindowKernel:
             self._warm_arm(arm)
             wb, kb, ingress = arm["wb"], arm["kb"], arm["ingress"]
             take = min(num_w - at, round_len * wb)
-            t0 = _time.perf_counter()
-            self._run_window_range(at, at + take, wb, kb, ingress,
-                                   make_chunk, recount, counts)
+            # the telemetry span is the round's stopwatch (identical
+            # perf_counter measurement with the recorder disarmed)
+            with telemetry.span("triangles.round", window=at,
+                                wb=wb, kb=kb, ingress=ingress,
+                                edges=take * eb) as sp:
+                self._run_window_range(at, at + take, wb, kb, ingress,
+                                       make_chunk, recount, counts)
             # record full rounds (or a whole call smaller than one):
             # a long stream's ragged tail has different per-edge
             # amortization and would drag the arm's EMA (and the
             # persisted cache) with tail economics
             if not freeze and take == min(round_len * wb, num_w):
-                tuner.record(arm, take * eb,
-                             _time.perf_counter() - t0)
+                tuner.record(arm, take * eb, sp.elapsed)
             at += take
         if not freeze:
             tuner.save()

@@ -323,6 +323,8 @@ class WindowedEdgeReduce:
         n = len(src0)
         if n == 0:
             return []
+        from ..utils import telemetry
+
         if self.name is not None:
             impl = _resolve_reduce_impl(self.name)
             if impl == "native":
@@ -333,18 +335,33 @@ class WindowedEdgeReduce:
                 # wherever ITS committed rows point, not blindly to
                 # the numpy tier
                 if np.issubdtype(val.dtype, np.signedinteger):
+                    # stopwatch, not span: a declined probe (None —
+                    # lib unavailable) must record NOTHING, or the
+                    # stream's edges would be double-counted against
+                    # the fallback tier's span in trace_report
+                    sw = telemetry.stopwatch("reduce.stream",
+                                             tier="native",
+                                             monoid=self.name,
+                                             edges=n)
                     got = self._native_process_stream(src0, dst0, val)
                     if got is not None:
+                        sw.stop()
                         return got
                 impl = _resolve_reduce_impl(self.name,
                                             allow_native=False)
             if impl == "host":
-                return self._host_process_stream(
-                    src0.astype(np.int64, copy=False),
-                    dst0.astype(np.int64, copy=False), val)
-        return self._device_process_stream(
-            src0.astype(np.int64, copy=False),
-            dst0.astype(np.int64, copy=False), val)
+                with telemetry.span("reduce.stream", tier="host",
+                                    monoid=self.name, edges=n):
+                    return self._host_process_stream(
+                        src0.astype(np.int64, copy=False),
+                        dst0.astype(np.int64, copy=False), val)
+        # device rounds run through the shared ingress pipeline, whose
+        # chunk/stage spans nest under this engine-level span
+        with telemetry.span("reduce.stream", tier="device",
+                            monoid=self.name or "fn", edges=n):
+            return self._device_process_stream(
+                src0.astype(np.int64, copy=False),
+                dst0.astype(np.int64, copy=False), val)
 
     def _native_process_stream(self, src, dst, val):
         """The C++ fused tier: one pass produces both cells and counts

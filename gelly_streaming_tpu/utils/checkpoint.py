@@ -40,6 +40,7 @@ from typing import Any, Callable, Dict, Optional
 import numpy as np
 
 from . import faults
+from . import telemetry
 
 _ARRAY_KEY = "__arrays__"
 
@@ -141,6 +142,9 @@ def save(path: str, tree: Any) -> None:
                 os.unlink(written)
             except OSError:
                 pass
+    # durable flight-recorder stamp: the completed save is exactly the
+    # recovery point a post-mortem needs to locate
+    telemetry.event("checkpoint_saved", durable=True, path=path)
     # external-damage injection point for the fault suite: fires AFTER
     # the atomic replace, modelling damage to a completed checkpoint
     faults.fire("ckpt_save", path)
@@ -161,7 +165,9 @@ def restore(path: str) -> Any:
             spec = json.loads(bytes(data[_ARRAY_KEY + "spec"]).decode())
             arrays = {k: data[k] for k in data.files
                       if k != _ARRAY_KEY + "spec"}
-        return _unflatten(spec, arrays)
+        tree = _unflatten(spec, arrays)
+        telemetry.event("checkpoint_restored", path=path)
+        return tree
     except (zipfile.BadZipFile, zlib.error, ValueError, KeyError,
             EOFError, json.JSONDecodeError, TypeError,
             IndexError) as e:
@@ -169,6 +175,8 @@ def restore(path: str) -> Any:
         # damaged archives: truncation -> BadZipFile/EOFError,
         # bit-flipped deflate -> zlib.error, mangled payloads ->
         # ValueError/KeyError/TypeError/IndexError/JSONDecodeError
+        telemetry.event("checkpoint_corrupt", durable=True, path=path,
+                        cause=type(e).__name__)
         raise CheckpointCorrupt(path, e) from e
 
 

@@ -62,6 +62,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from . import telemetry
+
 
 class InjectedFault(RuntimeError):
     """A fault raised by the active plan. `site` names the hook that
@@ -110,6 +112,13 @@ class FaultPlan:
                     if s.site == site and s._matches(n)]
             for s in hits:
                 self.fired.append((site, n, s.action))
+        # injected faults are part of the run's timeline: the flight
+        # recorder (utils/telemetry) stamps each firing so a chaos
+        # run's ledger interleaves faults with the spans they poisoned
+        for s in hits:
+            telemetry.event("fault_injected", durable=s.fatal,
+                            site=site, call=n, action=s.action,
+                            fatal=s.fatal)
         # act OUTSIDE the lock: a hang must not serialize other sites
         for s in hits:
             payload = _act(s, site, n, payload)
@@ -118,6 +127,12 @@ class FaultPlan:
 
 def _act(spec: FaultSpec, site: str, call_no: int, payload):
     if spec.action == "raise":
+        if spec.fatal:
+            # the simulated hard kill: flush the telemetry ring FIRST,
+            # so the post-kill ledger still holds the pre-kill spans —
+            # the flight-recorder durability contract
+            # tools/chaos_run.py and tests/test_telemetry.py assert
+            telemetry.on_fatal(site)
         exc = spec.exc
         if exc is None:
             raise InjectedFault(

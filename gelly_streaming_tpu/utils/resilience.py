@@ -40,6 +40,7 @@ import time
 from typing import Callable, List, Optional
 
 from . import faults
+from . import telemetry
 
 
 class StageError(RuntimeError):
@@ -47,7 +48,12 @@ class StageError(RuntimeError):
     name ('prep' / 'h2d' / 'dispatch' / 'finalize'), `chunk` the chunk
     descriptor the caller passed, `attempts` one dict per attempt:
     {"outcome": "timeout" | exception class name, "elapsed_s": float}.
-    """
+
+    Construction stamps a durable flight-recorder event
+    (utils/telemetry): a typed stage failure is exactly the
+    post-mortem evidence the run ledger exists for, and putting the
+    stamp here covers BOTH guard implementations (call_guarded and
+    ingress_pipeline._guarded_prep_h2d) by construction."""
 
     def __init__(self, message: str, stage: str, chunk,
                  attempts: Optional[List[dict]] = None):
@@ -55,6 +61,13 @@ class StageError(RuntimeError):
         self.stage = stage
         self.chunk = chunk
         self.attempts = attempts or []
+        telemetry.event(
+            {"StageTimeout": "stage_timeout",
+             "StageFailed": "stage_failed"}.get(type(self).__name__,
+                                                "stage_error"),
+            durable=True, stage=stage,
+            chunk=telemetry.chunk_key(chunk),
+            attempts=len(self.attempts))
 
 
 class StageTimeout(StageError):
@@ -192,6 +205,10 @@ def call_guarded(stage: str, chunk, fn: Callable, *,
                     "timings on .attempts)"
                     % (stage, chunk, timeout, len(attempts)),
                     stage, chunk, attempts)
+        telemetry.event("stage_retry", stage=stage,
+                        chunk=telemetry.chunk_key(chunk),
+                        attempt=attempt + 1,
+                        outcome=attempts[-1]["outcome"])
         time.sleep(backoff * (2 ** attempt))
 
 
@@ -217,6 +234,9 @@ def record_demotion(component: str, from_tier: str, to_tier: str,
     }
     with _DEMOTIONS_LOCK:
         _DEMOTIONS.append(event)
+    # durable flight-recorder stamp: a demotion must survive whatever
+    # killed the tier (the whole point of the run ledger)
+    telemetry.event("tier_demotion", durable=True, **event)
     return event
 
 

@@ -1,0 +1,543 @@
+"""Flight recorder: unified span/counter/gauge telemetry with a
+crash-safe run ledger.
+
+Three generations of ad-hoc instrumentation grew side by side —
+`utils/tracing.StepTimer`, `ops/ingress_pipeline.StageTimers`,
+`utils/resilience` event dicts, and raw perf_counter() spans in the
+autotuned round loops — none sharing a schema, a correlation ID, or a
+durable sink, so a wedged tunnel session still died as a "dead queue
+hour" with no post-mortem evidence. This module is the ONE recorder
+they all feed:
+
+- **Spans** (named timed intervals with attributes), **events**
+  (discrete happenings: demotions, injected faults, checkpoints,
+  resumes), **counters** and **gauges** — every record carries the
+  process-wide run *trace ID* plus whatever correlation attributes
+  the caller binds (chunk index, window range), so a chaos run reads
+  as one coherent timeline across the pipeline's threads.
+- Span *nesting* is tracked per thread (a span opened inside another
+  records its parent span id); cross-thread stages (the ingress prep
+  pool) attach to their chunk span via an explicit ctx handle instead
+  (`chunk_ctx`/`close_chunk` — thread-locals do not cross the pool).
+- A bounded in-memory **ring buffer** (`GS_TRACE_RING`, default 4096
+  records) holds the recent history at near-zero cost.
+- A **crash-safe JSONL ledger** (`GS_TRACE_DIR`): durable-class
+  events (fault kills, demotions, stage timeouts, checkpoints,
+  resumes) are appended AND fsync'd the moment they close
+  (`GS_TRACE_DURABLE=0` drops the fsync); ordinary spans ride the
+  ring and are flushed by `flush()`, `atexit`, a fatal injected
+  fault (utils/faults hooks `on_fatal`), or SIGTERM — so a
+  kill-adjacent wedge still leaves the last N spans on disk. The
+  ledger is append-only with one JSON object per line; readers
+  (tools/trace_report.py) skip a torn final line, the same
+  damage-tolerant discipline as utils/checkpoint.
+
+Zero-overhead contract: with `GS_TELEMETRY=0` (the default) every
+recording call is a guarded no-op and `span()` degrades to a bare
+perf_counter stopwatch — exactly the measurement the migrated call
+sites performed before — so the hot path is bit-identical armed or
+not (asserted by tests/test_telemetry.py digest parity).
+
+Knobs:
+    GS_TELEMETRY      0 (default) = disarmed no-ops; 1 = record
+    GS_TRACE_DIR      ledger directory (unset = ring only, no disk)
+    GS_TRACE_RING     ring capacity in records (default 4096)
+    GS_TRACE_DURABLE  1 (default) = fsync durable-class appends
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+_DEF_RING = 4096
+_SAMPLE_CAP = 2048  # per-span-name duration reservoir for summary()
+
+clock = time.perf_counter  # the one monotonic clock every record uses
+
+
+# ----------------------------------------------------------------------
+# env knobs (read per call, like utils/resilience: tests and tools flip
+# them mid-process)
+# ----------------------------------------------------------------------
+def enabled() -> bool:
+    """GS_TELEMETRY arms the recorder; off (the default) every hook is
+    a guarded no-op and span() is a bare stopwatch."""
+    return os.environ.get("GS_TELEMETRY", "0") not in ("0", "")
+
+
+def trace_dir() -> Optional[str]:
+    """Ledger directory (GS_TRACE_DIR); None = ring only."""
+    return os.environ.get("GS_TRACE_DIR") or None
+
+
+def ring_size() -> int:
+    try:
+        return max(16, int(os.environ.get("GS_TRACE_RING",
+                                          str(_DEF_RING))))
+    except ValueError:
+        return _DEF_RING
+
+
+def durable_sync() -> bool:
+    """GS_TRACE_DURABLE=0 drops the per-durable-event fsync (append
+    still happens; only the power-loss window widens)."""
+    return os.environ.get("GS_TRACE_DURABLE", "1") != "0"
+
+
+# ----------------------------------------------------------------------
+# the process-global recorder
+# ----------------------------------------------------------------------
+class _Recorder:
+    """All mutable state behind one lock: the ring, the per-name
+    aggregates summary() renders, the ledger file, and the id
+    counters. One instance per process (rebuilt by reset())."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.trace = "%x-%x" % (os.getpid(),
+                                int(time.time() * 1e3) & 0xFFFFFFFF)
+        self.epoch = time.time()
+        self.mono = clock()
+        self.ring = collections.deque(maxlen=ring_size())
+        self.next_sid = 1
+        self.agg: Dict[str, dict] = {}
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.ledger = None        # open file object, lazily created
+        self.ledger_path = None
+
+    # -- ledger --------------------------------------------------------
+    def _ensure_ledger(self):
+        """Open (once) the append-only JSONL ledger under
+        GS_TRACE_DIR, writing the meta anchor line readers use to map
+        monotonic span timestamps back to wall time."""
+        if self.ledger is not None:
+            return self.ledger
+        d = trace_dir()
+        if d is None:
+            return None
+        os.makedirs(d, exist_ok=True)
+        self.ledger_path = os.path.join(d,
+                                        "trace_%s.jsonl" % self.trace)
+        self.ledger = open(self.ledger_path, "a")
+        self.ledger.write(json.dumps({
+            "t": "meta", "trace": self.trace, "pid": os.getpid(),
+            "epoch": self.epoch, "mono": self.mono,
+            "ring": self.ring.maxlen}) + "\n")
+        self.ledger.flush()
+        _install_exit_hooks()
+        return self.ledger
+
+    def _append(self, rec: dict, sync: bool) -> None:
+        f = self._ensure_ledger()
+        if f is None:
+            return
+        f.write(json.dumps(rec, default=str) + "\n")
+        rec["_w"] = True  # private written mark, stripped on flush
+        if sync:
+            f.flush()
+            if durable_sync():
+                try:
+                    os.fsync(f.fileno())
+                except OSError:
+                    pass
+
+    def flush(self) -> None:
+        """Drain every not-yet-written ring record to the ledger (the
+        atexit / fatal-fault / operator path)."""
+        with self.lock:
+            f = self._ensure_ledger()
+            if f is None:
+                return
+            for rec in self.ring:
+                if not rec.get("_w"):
+                    f.write(json.dumps(
+                        {k: v for k, v in rec.items() if k != "_w"},
+                        default=str) + "\n")
+                    rec["_w"] = True
+            f.flush()
+            try:
+                os.fsync(f.fileno())
+            except OSError:
+                pass
+
+    # -- recording -----------------------------------------------------
+    def add(self, rec: dict, durable: bool = False) -> None:
+        with self.lock:
+            self.ring.append(rec)
+            if rec["t"] == "span":
+                a = self.agg.setdefault(rec["name"], {
+                    "count": 0, "total": 0.0,
+                    "samples": collections.deque(maxlen=_SAMPLE_CAP)})
+                a["count"] += 1
+                a["total"] += rec["dur"]
+                a["samples"].append(rec["dur"])
+            elif rec["t"] == "counter":
+                self.counters[rec["name"]] = (
+                    self.counters.get(rec["name"], 0) + rec["value"])
+            elif rec["t"] == "gauge":
+                self.gauges[rec["name"]] = rec["value"]
+            if durable:
+                self._append(rec, sync=True)
+
+    def sid(self) -> int:
+        with self.lock:
+            s = self.next_sid
+            self.next_sid += 1
+            return s
+
+
+_REC: Optional[_Recorder] = None
+_REC_LOCK = threading.Lock()
+_TLS = threading.local()
+_HOOKS_INSTALLED = False
+
+
+def _rec() -> _Recorder:
+    global _REC
+    if _REC is None:
+        with _REC_LOCK:
+            if _REC is None:
+                _REC = _Recorder()
+    return _REC
+
+
+def _install_exit_hooks() -> None:
+    """atexit + SIGTERM flush, installed once on first ledger open (a
+    ring-only recorder has nothing to save). SIGTERM chains any prior
+    handler; SIGKILL is of course uncatchable — the durable-class
+    immediate appends are what bound that loss to the ring."""
+    global _HOOKS_INSTALLED
+    if _HOOKS_INSTALLED:
+        return
+    _HOOKS_INSTALLED = True
+    atexit.register(flush)
+    try:
+        import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_term(signum, frame):
+            flush()
+            # preserve the prior disposition EXACTLY: chain a callable
+            # handler, die the default way for SIG_DFL, and keep the
+            # process alive when it deliberately ignored SIGTERM
+            # (SIG_IGN / unknown) — the flush must never change
+            # whether SIGTERM is survivable
+            if callable(prev):
+                prev(signum, frame)
+            elif prev is signal.SIG_DFL:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_term)
+    except (ValueError, OSError):
+        pass  # non-main thread / exotic platform: atexit still covers
+
+
+def reset() -> None:
+    """Test/tool hook: drop all recorded state and start a fresh trace
+    (closes the current ledger; a new one opens on the next record)."""
+    global _REC
+    with _REC_LOCK:
+        if _REC is not None and _REC.ledger is not None:
+            try:
+                _REC.flush()
+                _REC.ledger.close()
+            except (OSError, ValueError):
+                pass
+        _REC = None
+    _TLS.__dict__.clear()
+
+
+def trace_id() -> str:
+    """The process-wide run trace ID every record carries."""
+    return _rec().trace
+
+
+def ledger_path() -> Optional[str]:
+    """Path of this run's ledger file (None when GS_TRACE_DIR is
+    unset or nothing has been recorded to disk yet)."""
+    r = _rec()
+    if r.ledger_path is None and trace_dir() is not None:
+        with r.lock:
+            r._ensure_ledger()
+    return r.ledger_path
+
+
+def flush() -> None:
+    """Drain the ring to the ledger (no-op without GS_TRACE_DIR)."""
+    if _REC is not None:
+        _REC.flush()
+
+
+# ----------------------------------------------------------------------
+# context / correlation
+# ----------------------------------------------------------------------
+def _ctx_attrs() -> dict:
+    return getattr(_TLS, "ctx", None) or {}
+
+
+def _parent_sid() -> Optional[int]:
+    stack = getattr(_TLS, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def context(**attrs):
+    """Bind correlation attributes (chunk=..., window=...) to every
+    record made by THIS thread inside the scope; explicit per-record
+    attrs win on collision. Thread-local — pool workers need their
+    chunk identity passed explicitly (see chunk_ctx)."""
+    prev = getattr(_TLS, "ctx", None)
+    merged = dict(prev or {})
+    merged.update(attrs)
+    _TLS.ctx = merged
+    try:
+        yield
+    finally:
+        _TLS.ctx = prev
+
+
+def _record(kind: str, name: str, durable: bool = False,
+            **fields) -> Optional[dict]:
+    rec = {"t": kind, "name": name, "trace": _rec().trace,
+           "tid": threading.get_ident()}
+    ctx = _ctx_attrs()
+    if ctx:
+        a = dict(ctx)
+        a.update(fields.pop("a", None) or {})
+        fields["a"] = a
+    rec.update({k: v for k, v in fields.items() if v is not None})
+    if not rec.get("a"):
+        rec.pop("a", None)
+    if not _HOOKS_INSTALLED and trace_dir() is not None:
+        # a ledger-destined run must flush its ring at exit even if no
+        # durable event ever opens the file earlier
+        _install_exit_hooks()
+    _rec().add(rec, durable=durable)
+    return rec
+
+
+# ----------------------------------------------------------------------
+# spans
+# ----------------------------------------------------------------------
+class _Span:
+    """Context manager AND stopwatch. Always measures (callers like
+    the autotune round loops need `.elapsed` whether or not telemetry
+    is armed); records only when armed at __exit__ time. Nesting is
+    tracked per thread via the span-id stack."""
+
+    __slots__ = ("name", "attrs", "t0", "elapsed", "sid", "_pushed")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = clock()
+        self.elapsed = 0.0
+        self.sid = None
+        self._pushed = False
+
+    def __enter__(self):
+        self.t0 = clock()
+        if enabled():
+            self.sid = _rec().sid()
+            stack = getattr(_TLS, "stack", None)
+            if stack is None:
+                stack = _TLS.stack = []
+            stack.append(self.sid)
+            self._pushed = True
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.elapsed = clock() - self.t0
+        if self._pushed:
+            _TLS.stack.pop()
+            self._pushed = False
+        if enabled():
+            par = _parent_sid()
+            a = dict(self.attrs) if self.attrs else {}
+            if exc_type is not None:
+                a["error"] = exc_type.__name__
+            _record("span", self.name, ts=self.t0, dur=self.elapsed,
+                    sid=self.sid, par=par, a=a or None)
+        return False
+
+
+def span(name: str, **attrs) -> _Span:
+    """A named span: `with telemetry.span("step.intern", records=n)
+    as sp: ...`; sp.elapsed holds the measured seconds either way."""
+    return _Span(name, attrs)
+
+
+class _Stopwatch:
+    """Deferred span: started at construction, recorded by stop() —
+    for intervals that cross scopes (the driver's dispatch-to-dispatch
+    autotune rounds). Unstopped stopwatches record nothing."""
+
+    __slots__ = ("name", "attrs", "t0", "_done")
+
+    def __init__(self, name: Optional[str], attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.t0 = clock()
+        self._done = False
+
+    def stop(self, **extra) -> float:
+        """Close the interval; returns elapsed seconds (idempotent —
+        later calls return the first measurement without
+        re-recording)."""
+        if self._done:
+            return self.attrs.get("_elapsed", 0.0)
+        self._done = True
+        elapsed = clock() - self.t0
+        self.attrs["_elapsed"] = elapsed
+        if self.name is not None and enabled():
+            a = dict(self.attrs)
+            a.pop("_elapsed", None)
+            a.update(extra)
+            _record("span", self.name, ts=self.t0, dur=elapsed,
+                    sid=_rec().sid(), par=_parent_sid(), a=a or None)
+        return elapsed
+
+
+def stopwatch(name: Optional[str] = None, **attrs) -> _Stopwatch:
+    return _Stopwatch(name, attrs)
+
+
+def record_span(name: str, t0: float, dur: float,
+                parent: Optional[int] = None,
+                sid: Optional[int] = None, **attrs) -> None:
+    """Record an already-measured interval (the worker-side ingress
+    stages time themselves and report after the fact)."""
+    if not enabled():
+        return
+    _record("span", name, ts=t0, dur=dur,
+            sid=sid if sid is not None else _rec().sid(),
+            par=parent if parent is not None else _parent_sid(),
+            a=attrs or None)
+
+
+# -- cross-thread chunk correlation (the ingress pipeline) -------------
+def chunk_ctx(chunk) -> Optional[dict]:
+    """Open a chunk span handle the pool workers can parent their
+    stage spans to (thread-local nesting cannot cross the pool). The
+    span itself is recorded by close_chunk once the chunk's finalize
+    lands."""
+    if not enabled():
+        return None
+    return {"sid": _rec().sid(), "chunk": chunk, "t0": clock()}
+
+
+def close_chunk(ctx: Optional[dict], **attrs) -> None:
+    if ctx is None or not enabled():
+        return
+    _record("span", "ingress.chunk", ts=ctx["t0"],
+            dur=clock() - ctx["t0"], sid=ctx["sid"],
+            par=_parent_sid(),
+            a=dict(attrs, chunk=ctx["chunk"]))
+
+
+def chunk_key(item):
+    """A compact correlation id for a pipeline chunk descriptor:
+    ints (window starts) pass through; anything else is opaque."""
+    import numbers
+
+    if isinstance(item, numbers.Integral):
+        return int(item)
+    if isinstance(item, tuple) and item \
+            and isinstance(item[0], numbers.Integral):
+        return int(item[0])
+    return None
+
+
+# ----------------------------------------------------------------------
+# events / counters / gauges
+# ----------------------------------------------------------------------
+def event(name: str, durable: bool = False, **attrs) -> None:
+    """A discrete happening. durable=True appends + fsyncs the record
+    to the ledger immediately (demotions, kills, checkpoints, resumes
+    — the post-mortem class that must survive a wedge)."""
+    if not enabled():
+        return
+    _record("event", name, ts=clock(), durable=durable,
+            a=attrs or None)
+
+
+def counter(name: str, value: float = 1, **attrs) -> None:
+    if not enabled():
+        return
+    _record("counter", name, ts=clock(), value=value, a=attrs or None)
+
+
+def gauge(name: str, value: float, **attrs) -> None:
+    if not enabled():
+        return
+    _record("gauge", name, ts=clock(), value=value, a=attrs or None)
+
+
+def on_fatal(site: str = "") -> None:
+    """The simulated-hard-kill hook (utils/faults fatal InjectedFault):
+    stamp a durable event and flush the ring, so the post-kill ledger
+    still holds the pre-kill spans — the flight-recorder contract
+    tools/chaos_run.py asserts end-to-end."""
+    if not enabled():
+        return
+    event("fatal", durable=True, site=site)
+    flush()
+
+
+# ----------------------------------------------------------------------
+# aggregation (PERF.json `telemetry` section; shared histogram math)
+# ----------------------------------------------------------------------
+def percentiles(samples, ps=(50, 95, 99)) -> Dict[int, float]:
+    """Nearest-rank percentiles over `samples` (exact, no
+    interpolation: the p-th percentile is the ceil(p/100*n)-th
+    smallest sample) — the one histogram definition the recorder,
+    tools/trace_report.py, and the tests all share."""
+    xs = sorted(samples)
+    if not xs:
+        return {p: 0.0 for p in ps}
+    n = len(xs)
+    out = {}
+    for p in ps:
+        rank = max(1, -(-p * n // 100))  # ceil(p*n/100), 1-based
+        out[p] = float(xs[min(rank, n) - 1])
+    return out
+
+
+def summary(top: int = 0) -> List[dict]:
+    """Per-span-name latency rows (count, total, p50/p95/p99 over the
+    bounded sample reservoir), sorted by total time — the
+    schema-validated `telemetry` section tools commit to PERF.json."""
+    r = _rec()
+    with r.lock:
+        rows = []
+        for name, a in r.agg.items():
+            pct = percentiles(a["samples"])
+            rows.append({
+                "span": name,
+                "count": a["count"],
+                "total_ms": round(a["total"] * 1e3, 3),
+                "p50_ms": round(pct[50] * 1e3, 3),
+                "p95_ms": round(pct[95] * 1e3, 3),
+                "p99_ms": round(pct[99] * 1e3, 3),
+            })
+    rows.sort(key=lambda x: -x["total_ms"])
+    return rows[:top] if top else rows
+
+
+def records() -> List[dict]:
+    """Snapshot of the ring (tests / diagnostics), private marks
+    stripped."""
+    r = _rec()
+    with r.lock:
+        return [{k: v for k, v in rec.items() if k != "_w"}
+                for rec in r.ring]

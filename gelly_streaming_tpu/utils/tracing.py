@@ -4,7 +4,12 @@ The reference has none (SURVEY.md §5.1 — only wall-clock via
 getNetRuntime, CentralizedWeightedMatching.java:62-64). Here:
 
 - `StepTimer` — per-operator / per-window wall-time and record counts,
-  collected by the runtime when `env.enable_tracing()` is on.
+  collected by the runtime when `env.enable_tracing()` is on. Since
+  the flight recorder landed (utils/telemetry) StepTimer is a thin
+  adapter over it: `step()` measures through a telemetry span (so an
+  armed recorder sees every step as a `step.<name>` span with the
+  run's trace ID), while `report()`/`event_log()` and their
+  accumulation semantics are unchanged for existing call sites.
 - `device_trace` — context manager around `jax.profiler.trace` for a
   TensorBoard-readable XLA trace of the device kernels.
 """
@@ -12,9 +17,10 @@ getNetRuntime, CentralizedWeightedMatching.java:62-64). Here:
 from __future__ import annotations
 
 import contextlib
-import time
 from collections import defaultdict
 from typing import Dict, List
+
+from . import telemetry
 
 
 class StepTimer:
@@ -43,11 +49,15 @@ class StepTimer:
 
     @contextlib.contextmanager
     def step(self, name: str, num_records: int = 0):
-        t0 = time.perf_counter()
+        # the telemetry span IS the stopwatch (identical perf_counter
+        # measurement armed or not); the local accumulation keeps
+        # report() byte-compatible for existing consumers
+        sp = telemetry.span("step." + name, records=num_records)
         try:
-            yield
+            with sp:
+                yield
         finally:
-            self.add(name, time.perf_counter() - t0, num_records)
+            self.add(name, sp.elapsed, num_records)
 
     def report(self) -> List[dict]:
         out = []

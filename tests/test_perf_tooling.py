@@ -23,6 +23,7 @@ def _load_tool(name):
 
 perf_schema = _load_tool("perf_schema")
 update_perf_md = _load_tool("update_perf_md")
+trace_report = _load_tool("trace_report")
 
 
 # ----------------------------------------------------------------------
@@ -47,6 +48,7 @@ def test_schema_rejects_malformed_sections():
         "degradations": [{"from": "scan"}],        # missing to/window
         "pipeline_stages": ["not-a-dict"],
         "host_reduce_error": "not-a-dict",
+        "telemetry": [{"count": 3}],               # missing span
     }
     errors = perf_schema.validate(bad)
     joined = "\n".join(errors)
@@ -55,6 +57,7 @@ def test_schema_rejects_malformed_sections():
     assert "degradations" in joined
     assert "pipeline_stages" in joined
     assert "host_reduce_error" in joined
+    assert "telemetry" in joined and "'span'" in joined
     assert perf_schema.validate([]) != []       # top level must be dict
     assert perf_schema.validate({"backend": 3})  # backend must be str
 
@@ -112,6 +115,12 @@ FIXTURE = {
                              "ingress": "standard"}}],
     "degradations": [{"section": "driver", "from": "scan",
                       "to": "native", "window": 5, "reason": "t"}],
+    "telemetry": [{"span": "ingress.prep", "count": 16,
+                   "total_ms": 40.0, "p50_ms": 2.0, "p95_ms": 4.0,
+                   "p99_ms": 5.0}],
+    "telemetry_meta": {"engine": "triangle_stream+driver",
+                       "parity": True, "overhead_ratio": 1.01,
+                       "trace": "abc-123"},
     "sharded": {"collectives": {
         "config": {"n": 8, "vb": 65536, "kb": 32, "cap": 4096},
         "backend": "cpu-virtual-mesh", "note": "modeled",
@@ -133,7 +142,8 @@ def test_render_covers_every_new_section():
     for needle in ("d2h egress A/B", "Online dispatch autotuner",
                    "driver_ab", "triangle_stream",
                    "wb=64", "DEGRADED RUN", "Roofline",
-                   "Ingress pipeline per-stage timing"):
+                   "Ingress pipeline per-stage timing",
+                   "Flight recorder", "ingress.prep", "1.010"):
         assert needle in block, needle
 
 
@@ -156,6 +166,63 @@ def test_update_perf_md_round_trips_idempotently(tmp_path):
     update_perf_md.main(perf_path, md_path)  # idempotent
     with open(md_path) as f:
         assert f.read() == once
+
+
+# ----------------------------------------------------------------------
+# trace_report round-trips its committed fixture ledger (no network,
+# no chip): the tier-1 guard that the flight-recorder toolchain keeps
+# reading the ledgers real runs write
+# ----------------------------------------------------------------------
+LEDGER_FIXTURE = os.path.join(REPO, "tests", "fixtures",
+                              "telemetry_ledger.jsonl")
+
+
+def test_trace_report_loads_fixture_and_skips_torn_tail():
+    records = trace_report.load(LEDGER_FIXTURE)
+    # the fixture ends with a deliberately torn line (a crash
+    # mid-append): skipped, never fatal
+    assert not any("torn" in str(r.get("name", "")) for r in records)
+    assert trace_report.meta_of(records)["trace"] == "fixture-1"
+    kinds = {r["t"] for r in records}
+    assert {"meta", "span", "event", "counter"} <= kinds
+
+
+def test_trace_report_histograms_exact_on_fixture():
+    records = trace_report.load(LEDGER_FIXTURE)
+    rows = {r["span"]: r for r in trace_report.span_rows(records)}
+    prep = rows["ingress.prep"]
+    # durations committed in the fixture: 10/20/30/40 ms -> nearest
+    # rank p50=20, p95=40, p99=40; total 100
+    assert prep["count"] == 4
+    assert prep["total_ms"] == 100.0
+    assert (prep["p50_ms"], prep["p95_ms"], prep["p99_ms"]) \
+        == (20.0, 40.0, 40.0)
+    thr = {r["span"]: r
+           for r in trace_report.throughput_rows(records)}
+    # two triangles.round spans: 131072 edges over 0.2 s
+    assert thr["triangles.round"]["edges"] == 131072
+    assert thr["triangles.round"]["edges_per_s"] == 655360
+
+
+def test_trace_report_perfetto_and_render_round_trip(tmp_path):
+    records = trace_report.load(LEDGER_FIXTURE)
+    trace = json.loads(json.dumps(trace_report.to_perfetto(records)))
+    evs = trace["traceEvents"]
+    assert all({"name", "ph", "pid", "tid", "ts"} <= set(e)
+               for e in evs)
+    assert any(e["ph"] == "X" and e["name"] == "ingress.chunk"
+               for e in evs)
+    assert any(e["ph"] == "i" and e["name"] == "resume" for e in evs)
+    assert any(e["ph"] == "C" for e in evs)
+    text = trace_report.render(records)
+    for needle in ("fixture-1", "ingress.prep", "tier_demotion",
+                   "resume", "edges/s"):
+        assert needle in text, needle
+    # the CLI end-to-end: report + perfetto export, exit 0
+    out = str(tmp_path / "trace.json")
+    assert trace_report.main([LEDGER_FIXTURE, "--perfetto", out]) == 0
+    with open(out) as f:
+        assert json.load(f)["traceEvents"]
 
 
 def test_update_perf_md_appends_block_when_markers_absent(tmp_path):

@@ -1,0 +1,350 @@
+"""Flight-recorder suite (utils/telemetry + tools/trace_report.py):
+
+- span nesting and chunk correlation, including across the ingress
+  pipeline's worker threads (thread-locals don't cross the pool — the
+  chunk ctx handle does);
+- ring-buffer bounds (GS_TRACE_RING);
+- durable flush on the simulated fatal kill (the utils/faults
+  fatal-kill hook), proving the crash-safe ledger contract the chaos
+  soak asserts end-to-end;
+- Perfetto/Chrome trace export well-formedness;
+- `GS_TELEMETRY=0` digest parity on the 524K/32768 CPU row (the
+  zero-overhead contract: armed vs disarmed counts are bit-identical);
+- nearest-rank percentile math against known samples;
+- the StepTimer adapter: report()/event_log() unchanged, spans
+  forwarded when armed.
+"""
+
+import hashlib
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.utils import faults, telemetry
+from gelly_streaming_tpu.utils.tracing import StepTimer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(REPO, "tools", "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+trace_report = _load_trace_report()
+
+
+@pytest.fixture
+def armed(tmp_path, monkeypatch):
+    """Recorder armed with a ledger dir; reset before AND after so no
+    state (or open ledger handle) leaks across tests."""
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", str(tmp_path))
+    telemetry.reset()
+    yield str(tmp_path)
+    telemetry.reset()
+
+
+def _stream(num_edges, num_vertices, seed=7):
+    from bench import make_stream
+
+    return make_stream(num_edges, num_vertices, seed)
+
+
+# ----------------------------------------------------------------------
+# span nesting & correlation
+# ----------------------------------------------------------------------
+def test_span_nesting_same_thread(armed):
+    with telemetry.span("outer") as outer:
+        with telemetry.span("inner"):
+            pass
+    recs = {r["name"]: r for r in telemetry.records()}
+    assert recs["inner"]["par"] == recs["outer"]["sid"]
+    assert "par" not in recs["outer"]  # top-level span: no parent
+    assert outer.elapsed > 0
+    assert recs["inner"]["trace"] == recs["outer"]["trace"] \
+        == telemetry.trace_id()
+
+
+def test_chunk_ctx_links_across_threads(armed):
+    ctx = telemetry.chunk_ctx(7)
+
+    def worker():
+        t0 = telemetry.clock()
+        telemetry.record_span("ingress.prep", t0, 0.001,
+                              parent=ctx["sid"], chunk=ctx["chunk"])
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    telemetry.close_chunk(ctx)
+    recs = telemetry.records()
+    prep = next(r for r in recs if r["name"] == "ingress.prep")
+    chunk = next(r for r in recs if r["name"] == "ingress.chunk")
+    assert prep["par"] == chunk["sid"] == ctx["sid"]
+    assert prep["a"]["chunk"] == chunk["a"]["chunk"] == 7
+    assert prep["tid"] != chunk["tid"]  # recorded from the worker
+
+
+def test_pipeline_spans_correlate(armed):
+    """The real thing: a fused-scan engine fed multiple chunks through
+    the worker-pool ingress pipeline produces one chunk span per
+    chunk, with the worker-side prep/h2d spans parented to it."""
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    eng = StreamSummaryEngine(edge_bucket=1024, vertex_bucket=2048)
+    eng.MAX_WINDOWS = 2  # several chunks → the pool engages
+    src, dst = _stream(8 * 1024, 1024, seed=3)
+    eng.process(src, dst)
+    recs = telemetry.records()
+    names = {r["name"] for r in recs}
+    assert {"ingress.prep", "ingress.h2d", "ingress.dispatch",
+            "ingress.finalize", "ingress.chunk"} <= names
+    chunks = {r["sid"] for r in recs if r["name"] == "ingress.chunk"}
+    assert len(chunks) >= 4
+    preps = [r for r in recs if r["name"] == "ingress.prep"]
+    assert preps
+    for r in preps:
+        assert r.get("par") in chunks
+    # dispatch/finalize carry the same chunk correlation ids
+    for r in recs:
+        if r["name"] in ("ingress.dispatch", "ingress.finalize"):
+            assert r.get("par") in chunks
+
+
+def test_context_binds_correlation_attrs(armed):
+    with telemetry.context(window=42):
+        telemetry.event("probe")
+        with telemetry.span("work", edges=10):
+            pass
+    ev = next(r for r in telemetry.records() if r["name"] == "probe")
+    sp = next(r for r in telemetry.records() if r["name"] == "work")
+    assert ev["a"]["window"] == 42
+    assert sp["a"] == {"window": 42, "edges": 10}
+    telemetry.event("after")
+    after = next(r for r in telemetry.records()
+                 if r["name"] == "after")
+    assert "a" not in after  # the binding ended with the scope
+
+
+# ----------------------------------------------------------------------
+# ring bounds
+# ----------------------------------------------------------------------
+def test_ring_buffer_bounds(monkeypatch):
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.delenv("GS_TRACE_DIR", raising=False)
+    monkeypatch.setenv("GS_TRACE_RING", "32")
+    telemetry.reset()
+    try:
+        for i in range(200):
+            with telemetry.span("s%d" % (i % 3), i=i):
+                pass
+        recs = telemetry.records()
+        assert len(recs) == 32
+        # the ring keeps the NEWEST records
+        assert recs[-1]["a"]["i"] == 199
+        # ...while the aggregates saw everything
+        assert sum(r["count"] for r in telemetry.summary()) == 200
+    finally:
+        telemetry.reset()
+
+
+# ----------------------------------------------------------------------
+# durability
+# ----------------------------------------------------------------------
+def test_durable_event_hits_disk_immediately(armed):
+    with telemetry.span("buffered"):
+        pass
+    telemetry.event("tier_demotion", durable=True, window=3)
+    recs = trace_report.load(telemetry.ledger_path())
+    names = [r.get("name") for r in recs]
+    assert "tier_demotion" in names       # durable: on disk, no flush
+    assert "buffered" not in names        # ring-only until a flush
+    telemetry.flush()
+    names = [r.get("name")
+             for r in trace_report.load(telemetry.ledger_path())]
+    assert "buffered" in names
+    # a flush never duplicates the already-written durable event
+    assert names.count("tier_demotion") == 1
+
+
+def test_durable_flush_on_simulated_fatal(armed):
+    """The utils/faults fatal-kill hook: a fatal InjectedFault flushes
+    the ring before raising, so the post-kill ledger holds the
+    pre-kill spans — the flight-recorder contract."""
+    for i in range(10):
+        with telemetry.span("work", i=i):
+            pass
+    with pytest.raises(faults.InjectedFault):
+        with faults.inject(faults.FaultSpec(site="dispatch",
+                                            fatal=True)):
+            faults.fire("dispatch")
+    recs = trace_report.load(telemetry.ledger_path())
+    spans = [r for r in recs if r.get("t") == "span"
+             and r["name"] == "work"]
+    assert len(spans) == 10               # every pre-kill span on disk
+    names = [r.get("name") for r in recs]
+    assert "fatal" in names
+    assert "fault_injected" in names
+    # one trace id across the whole ledger
+    trace = telemetry.trace_id()
+    assert all(r.get("trace") == trace for r in recs
+               if r.get("t") != "meta")
+    assert any(r.get("trace") == trace for r in recs
+               if r.get("t") == "meta")
+
+
+def test_ledger_tolerates_torn_tail(armed, tmp_path):
+    telemetry.event("resume", durable=True, windows_done=4)
+    path = telemetry.ledger_path()
+    with open(path, "a") as f:
+        f.write('{"t": "span", "name": "torn')  # the crash mid-append
+    recs = trace_report.load(path)
+    assert any(r.get("name") == "resume" for r in recs)
+    assert not any(r.get("name") == "torn" for r in recs)
+
+
+# ----------------------------------------------------------------------
+# Perfetto export
+# ----------------------------------------------------------------------
+def test_perfetto_export_well_formed(armed):
+    with telemetry.span("a", edges=100):
+        with telemetry.span("b"):
+            pass
+    telemetry.event("resume", durable=True, windows_done=3)
+    telemetry.counter("edges_seen", 100)
+    telemetry.flush()
+    recs = trace_report.load(telemetry.ledger_path())
+    trace = json.loads(json.dumps(trace_report.to_perfetto(recs)))
+    evs = trace["traceEvents"]
+    assert evs and all({"name", "ph", "pid", "tid", "ts"} <= set(e)
+                       for e in evs)
+    complete = [e for e in evs if e["ph"] == "X"]
+    assert {e["name"] for e in complete} == {"a", "b"}
+    assert all(e["dur"] >= 0 for e in complete)
+    assert any(e["ph"] == "i" and e["name"] == "resume" for e in evs)
+    assert any(e["ph"] == "C" for e in evs)
+    assert trace["otherData"]["trace"] == telemetry.trace_id()
+
+
+# ----------------------------------------------------------------------
+# the zero-overhead contract
+# ----------------------------------------------------------------------
+def test_disarmed_digest_parity_524k_row(monkeypatch, tmp_path):
+    """GS_TELEMETRY=0 vs 1 on the 524K/32768 CPU bench row: counts
+    are bit-identical (the recorder observes, never participates)."""
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    src, dst = _stream(524288, 65536)
+    monkeypatch.setenv("GS_TELEMETRY", "0")
+    telemetry.reset()
+    kern = TriangleWindowKernel(edge_bucket=32768,
+                                vertex_bucket=65536)
+    base = kern.count_stream(src, dst)
+    assert telemetry.records() == []      # disarmed: nothing recorded
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        armed = kern.count_stream(src, dst)
+    finally:
+        recorded = bool(telemetry.records())
+        telemetry.reset()
+    digest = lambda c: hashlib.sha256(  # noqa: E731
+        np.asarray(c, np.int64).tobytes()).hexdigest()
+    assert digest(base) == digest(armed)
+    assert recorded                       # armed: the row was observed
+
+
+# ----------------------------------------------------------------------
+# histogram math
+# ----------------------------------------------------------------------
+def test_percentile_math_known_samples():
+    pct = telemetry.percentiles(list(range(1, 101)))
+    assert pct == {50: 50.0, 95: 95.0, 99: 99.0}
+    assert telemetry.percentiles([7]) == {50: 7.0, 95: 7.0, 99: 7.0}
+    assert telemetry.percentiles([]) == {50: 0.0, 95: 0.0, 99: 0.0}
+    # nearest-rank (ceil), order-independent
+    assert telemetry.percentiles([4, 2, 3, 1], ps=(50,)) == {50: 2.0}
+    assert telemetry.percentiles([1, 2, 3], ps=(50,)) == {50: 2.0}
+    assert telemetry.percentiles([10, 20], ps=(99,)) == {99: 20.0}
+
+
+def test_summary_rows_shape(armed):
+    for _ in range(5):
+        with telemetry.span("x"):
+            pass
+    with telemetry.span("y"):
+        pass
+    rows = {r["span"]: r for r in telemetry.summary()}
+    assert rows["x"]["count"] == 5 and rows["y"]["count"] == 1
+    for r in rows.values():
+        assert {"span", "count", "total_ms", "p50_ms", "p95_ms",
+                "p99_ms"} <= set(r)
+        assert r["p50_ms"] <= r["p95_ms"] <= r["p99_ms"]
+
+
+# ----------------------------------------------------------------------
+# adapters
+# ----------------------------------------------------------------------
+def test_steptimer_adapter_armed(armed):
+    t = StepTimer()
+    with t.step("intern", 10):
+        pass
+    t.event("tier_demotion", {"window": 1})
+    # legacy surface unchanged
+    assert t.report()[0]["op"] == "intern"
+    assert t.counts["intern"] == 1 and t.records["intern"] == 10
+    assert t.event_log() == [{"event": "tier_demotion", "window": 1}]
+    # and the recorder saw the step as a span
+    spans = [r for r in telemetry.records()
+             if r["name"] == "step.intern"]
+    assert len(spans) == 1
+    assert spans[0]["a"]["records"] == 10
+
+
+def test_steptimer_disarmed_is_inert(monkeypatch):
+    monkeypatch.setenv("GS_TELEMETRY", "0")
+    telemetry.reset()
+    t = StepTimer()
+    with t.step("x", 1):
+        pass
+    assert t.counts["x"] == 1
+    assert telemetry.records() == []
+
+
+def test_resume_and_checkpoint_events(armed, tmp_path):
+    """Driver checkpoint/resume stamps durable ledger events under
+    the same trace — the crash-evidence pairing chaos_run asserts at
+    soak scale."""
+    from gelly_streaming_tpu.core.driver import (
+        StreamingAnalyticsDriver)
+
+    src, dst = _stream(4096, 512, seed=5)
+    ckpt = str(tmp_path / "job.npz")
+
+    def make():
+        return StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=1024, vertex_bucket=1024,
+            analytics=("degrees", "cc"))
+
+    drv = make()
+    drv.enable_auto_checkpoint(ckpt, every_n_windows=2)
+    drv.run_arrays(src, dst)
+    drv2 = make()
+    assert drv2.try_resume(ckpt)
+    # both event classes are durable: readable with NO flush
+    recs = trace_report.load(telemetry.ledger_path())
+    names = {r.get("name") for r in recs}
+    assert "checkpoint_saved" in names
+    assert "resume" in names
+    resume = next(r for r in recs if r.get("name") == "resume")
+    assert resume["a"]["windows_done"] == drv2.windows_done

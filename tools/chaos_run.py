@@ -52,7 +52,8 @@ from gelly_streaming_tpu.core.driver import (  # noqa: E402
     StreamingAnalyticsDriver)
 from gelly_streaming_tpu.ops.scan_analytics import (  # noqa: E402
     StreamSummaryEngine)
-from gelly_streaming_tpu.utils import faults, resilience  # noqa: E402
+from gelly_streaming_tpu.utils import (  # noqa: E402
+    faults, resilience, telemetry)
 
 KNOBS = {"GS_STAGE_TIMEOUT_S": "1", "GS_STAGE_RETRIES": "2",
          "GS_STAGE_BACKOFF_S": "0.05"}
@@ -276,6 +277,62 @@ def leg_autotune(path: str, eb: int, num_w: int, workdir: str) -> dict:
                 os.environ[k] = v
 
 
+def assert_flight_recorder(num_kills: int) -> dict:
+    """The flight-recorder durability leg: after the kill→resume
+    drills, the run ledger (utils/telemetry, armed by main) must hold
+    — under ONE trace ID — the chunk/stage spans recorded BEFORE the
+    first simulated kill, the durable fatal/fault events themselves,
+    and a post-kill `resume` event. This turns the recorder from
+    instrumentation into verified crash evidence: a wedge that used
+    to die as a dead queue hour now provably leaves its last spans on
+    disk."""
+    telemetry.flush()
+    path = telemetry.ledger_path()
+    if path is None or not os.path.exists(path):
+        raise SystemExit("flight recorder: no ledger was written")
+    trace = telemetry.trace_id()
+    recs = []
+    with open(path) as f:
+        for line in f:
+            try:
+                recs.append(json.loads(line))
+            except ValueError:
+                pass
+    body = [r for r in recs if r.get("t") != "meta"]
+    foreign = [r for r in body if r.get("trace") != trace]
+    if foreign:
+        raise SystemExit("flight recorder: %d records carry a foreign "
+                         "trace id" % len(foreign))
+    fatals = [r for r in body if r.get("t") == "event"
+              and r.get("name") == "fatal"]
+    resumes = [r for r in body if r.get("t") == "event"
+               and r.get("name") == "resume"]
+    if len(fatals) < num_kills:
+        raise SystemExit("flight recorder: expected >=%d fatal events,"
+                         " ledger has %d" % (num_kills, len(fatals)))
+    if not resumes:
+        raise SystemExit("flight recorder: no resume event in the "
+                         "ledger")
+    kill_ts = min(float(r.get("ts", 0)) for r in fatals)
+    pre_kill = [r for r in body if r.get("t") == "span"
+                and float(r.get("ts", 0)) < kill_ts]
+    if not pre_kill:
+        raise SystemExit("flight recorder: no pre-kill spans survived "
+                         "into the ledger")
+    if not any(float(r.get("ts", 0)) > kill_ts for r in resumes):
+        raise SystemExit("flight recorder: no resume event AFTER the "
+                         "kill")
+    return {
+        "trace": trace,
+        "ledger": os.path.basename(path),
+        "records": len(body),
+        "pre_kill_spans": len(pre_kill),
+        "fatal_events": len(fatals),
+        "resume_events": len(resumes),
+        "durable_parity": True,
+    }
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--edges", type=int, default=524288)
@@ -304,19 +361,42 @@ def main():
     with tempfile.TemporaryDirectory(prefix="gs-chaos-") as workdir:
         path = os.path.join(workdir, "edges.txt")
         _write_stream(path, src, dst)
-        a = leg_driver(path, args.eb, num_w, workdir)
-        # autotune leg: scan tier + live tuner, kill → resume, tuning
-        # state must round-trip the checkpoint bit-for-bit
-        at = leg_autotune(path, args.eb, num_w, workdir)
-        # leg B runs a right-sized twin stream: the fused scan's CPU
-        # cold-compile + materialize must FIT the 1 s chaos deadline
-        # (at vb=65536 the first chunk's finalize legitimately
-        # exceeds it); the row-scale parity proof is leg A's
-        engine_vb = 8192
-        b_src, b_dst = make_stream(
-            args.engine_windows * args.engine_eb, engine_vb, seed=13)
-        b = leg_engine(b_src, b_dst, args.engine_eb, engine_vb,
-                       args.engine_windows, workdir)
+        # arm the flight recorder for the whole soak: every leg's
+        # spans, faults, demotions, checkpoints and resumes land in
+        # ONE run ledger under one trace ID, and the recorder leg
+        # below asserts the ledger survived the kills
+        tel_prev = {k: os.environ.get(k)
+                    for k in ("GS_TELEMETRY", "GS_TRACE_DIR")}
+        os.environ["GS_TELEMETRY"] = "1"
+        os.environ["GS_TRACE_DIR"] = workdir
+        telemetry.reset()
+        try:
+            a = leg_driver(path, args.eb, num_w, workdir)
+            # autotune leg: scan tier + live tuner, kill → resume,
+            # tuning state must round-trip the checkpoint bit-for-bit
+            at = leg_autotune(path, args.eb, num_w, workdir)
+            # leg B runs a right-sized twin stream: the fused scan's
+            # CPU cold-compile + materialize must FIT the 1 s chaos
+            # deadline (at vb=65536 the first chunk's finalize
+            # legitimately exceeds it); the row-scale parity proof is
+            # leg A's
+            engine_vb = 8192
+            b_src, b_dst = make_stream(
+                args.engine_windows * args.engine_eb, engine_vb,
+                seed=13)
+            b = leg_engine(b_src, b_dst, args.engine_eb, engine_vb,
+                           args.engine_windows, workdir)
+            # flight-recorder leg: three kills fired above (driver,
+            # autotune, engine) — the ledger must prove all of them
+            fr = assert_flight_recorder(num_kills=3)
+            fr["span_summary"] = telemetry.summary(top=12)
+        finally:
+            telemetry.reset()  # close the ledger inside the tempdir
+            for k, v in tel_prev.items():  # restore, never just pop:
+                if v is None:              # an operator-armed session
+                    os.environ.pop(k, None)  # must stay armed after
+                else:
+                    os.environ[k] = v
 
     classes = set()
     for leg in (a, b):
@@ -338,6 +418,7 @@ def main():
         "vertices": args.vertices,
         "knobs": KNOBS,
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
+        "flight_recorder_leg": fr,
         "fault_classes_fired": sorted(classes),
         "demotions": resilience.demotion_events(),
         "parity": True,

@@ -43,6 +43,9 @@ LIST_SECTIONS = {
     "compile_probe_scan": ("program", "slots", "ok"),
     "degradations": ("from", "to", "window"),
     "ingress_probes": ("probe",),
+    # flight-recorder summary rows (utils/telemetry.summary():
+    # per-span latency aggregates a profiler/chaos run commits)
+    "telemetry": ("span", "count"),
 }
 
 # A/B sections whose parity-true rows must claim a positive speedup

@@ -1,0 +1,228 @@
+#!/usr/bin/env python
+"""Read a flight-recorder run ledger (utils/telemetry JSONL) and turn
+it into operator-readable evidence:
+
+  - per-span latency histograms (count, total, p50/p95/p99) using the
+    recorder's own nearest-rank percentile math,
+  - per-window/per-chunk throughput (edges/s from spans that carry an
+    `edges` attribute),
+  - the event timeline (faults, retries, demotions, checkpoints,
+    resumes, autotune decisions) in wall-clock order,
+  - a Chrome/Perfetto `trace.json` export (`--perfetto out.json`) for
+    flame-style inspection: load it at ui.perfetto.dev or
+    chrome://tracing.
+
+Ledger damage tolerance matches the writer's contract: a torn final
+line (the process died mid-append) is skipped, not fatal — the whole
+point of a crash-safe recorder is that its reader works on the ledger
+a crash left behind.
+
+Usage:
+  python tools/trace_report.py LEDGER.jsonl [--perfetto out.json]
+                               [--json] [--top N]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from gelly_streaming_tpu.utils.telemetry import percentiles  # noqa: E402
+
+
+def load(path: str) -> list:
+    """Parse one ledger: a list of record dicts, bad/torn lines
+    skipped. Raises on an unreadable FILE (that is operational, not
+    damage)."""
+    records = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail line: the crash the ledger is for
+            if isinstance(rec, dict) and "t" in rec:
+                records.append(rec)
+    return records
+
+
+def meta_of(records: list) -> dict:
+    for rec in records:
+        if rec["t"] == "meta":
+            return rec
+    return {}
+
+
+def span_rows(records: list) -> list:
+    """Per-span-name latency rows, sorted by total time — the same
+    shape telemetry.summary() commits to PERF.json."""
+    groups = {}
+    for rec in records:
+        if rec["t"] != "span":
+            continue
+        groups.setdefault(rec["name"], []).append(
+            float(rec.get("dur", 0.0)))
+    rows = []
+    for name, durs in groups.items():
+        pct = percentiles(durs)
+        rows.append({
+            "span": name,
+            "count": len(durs),
+            "total_ms": round(sum(durs) * 1e3, 3),
+            "p50_ms": round(pct[50] * 1e3, 3),
+            "p95_ms": round(pct[95] * 1e3, 3),
+            "p99_ms": round(pct[99] * 1e3, 3),
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def throughput_rows(records: list) -> list:
+    """edges/s per span name, from spans carrying an `edges`
+    attribute (the engine rounds and chunk spans do)."""
+    groups = {}
+    for rec in records:
+        if rec["t"] != "span":
+            continue
+        edges = (rec.get("a") or {}).get("edges")
+        if not edges:
+            continue
+        g = groups.setdefault(rec["name"], {"edges": 0, "s": 0.0,
+                                            "n": 0})
+        g["edges"] += int(edges)
+        g["s"] += float(rec.get("dur", 0.0))
+        g["n"] += 1
+    return [{"span": name, "rounds": g["n"], "edges": g["edges"],
+             "edges_per_s": round(g["edges"] / g["s"]) if g["s"] else 0}
+            for name, g in sorted(groups.items())]
+
+
+def event_rows(records: list) -> list:
+    out = [rec for rec in records if rec["t"] == "event"]
+    out.sort(key=lambda rec: rec.get("ts", 0.0))
+    return out
+
+
+def to_perfetto(records: list) -> dict:
+    """Chrome trace-event JSON (the object form with `traceEvents`):
+    one complete ('X') event per span with microsecond ts/dur, one
+    instant ('i') event per recorded event, counters as 'C'. Span
+    timestamps are the recorder's monotonic clock; the meta line's
+    epoch/mono anchor is attached as trace metadata."""
+    meta = meta_of(records)
+    pid = meta.get("pid", 0)
+    events = []
+    for rec in records:
+        kind = rec["t"]
+        if kind == "meta":
+            continue
+        base = {
+            "name": rec.get("name", "?"),
+            "pid": pid,
+            "tid": rec.get("tid", 0),
+            "ts": round(float(rec.get("ts", 0.0)) * 1e6, 3),
+        }
+        args = dict(rec.get("a") or {})
+        if kind == "span":
+            events.append(dict(
+                base, ph="X", cat="span",
+                dur=round(float(rec.get("dur", 0.0)) * 1e6, 3),
+                args=dict(args, sid=rec.get("sid"),
+                          par=rec.get("par"))))
+        elif kind == "event":
+            events.append(dict(base, ph="i", cat="event", s="p",
+                               args=args))
+        elif kind in ("counter", "gauge"):
+            events.append(dict(base, ph="C", cat=kind,
+                               args={"value": rec.get("value", 0)}))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"trace": meta.get("trace"),
+                      "epoch": meta.get("epoch"),
+                      "mono": meta.get("mono")},
+    }
+
+
+def render(records: list, top: int = 0) -> str:
+    meta = meta_of(records)
+    lines = ["ledger trace=%s pid=%s  (%d records)"
+             % (meta.get("trace", "?"), meta.get("pid", "?"),
+                len(records)), ""]
+    rows = span_rows(records)
+    if top:
+        rows = rows[:top]
+    if rows:
+        lines += ["span                        count   total ms"
+                  "    p50 ms    p95 ms    p99 ms",
+                  "-" * 78]
+        for r in rows:
+            lines.append(
+                "%-27s %5d %10.3f %9.3f %9.3f %9.3f"
+                % (r["span"][:27], r["count"], r["total_ms"],
+                   r["p50_ms"], r["p95_ms"], r["p99_ms"]))
+        lines.append("")
+    thr = throughput_rows(records)
+    if thr:
+        lines += ["throughput (spans carrying `edges`):"]
+        for r in thr:
+            lines.append("  %-27s %5d rounds  %10d edges  %10d edges/s"
+                         % (r["span"][:27], r["rounds"], r["edges"],
+                            r["edges_per_s"]))
+        lines.append("")
+    evs = event_rows(records)
+    if evs:
+        lines += ["event timeline:"]
+        for rec in evs:
+            attrs = " ".join("%s=%s" % kv
+                             for kv in sorted((rec.get("a")
+                                               or {}).items()))
+            lines.append("  %12.6fs  %-20s %s"
+                         % (float(rec.get("ts", 0.0)),
+                            rec.get("name", "?"), attrs))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("ledger", help="run ledger (trace_*.jsonl)")
+    ap.add_argument("--perfetto", metavar="OUT",
+                    help="write a Chrome/Perfetto trace.json here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as JSON instead of text")
+    ap.add_argument("--top", type=int, default=0,
+                    help="limit the span table to the top N rows")
+    args = ap.parse_args(argv)
+
+    records = load(args.ledger)
+    if not records:
+        print("no usable records in %s" % args.ledger, file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps({
+            "meta": meta_of(records),
+            "spans": span_rows(records)[:args.top or None],
+            "throughput": throughput_rows(records),
+            "events": event_rows(records),
+        }, indent=2, default=str))
+    else:
+        print(render(records, args.top))
+    if args.perfetto:
+        trace = to_perfetto(records)
+        with open(args.perfetto, "w") as f:
+            json.dump(trace, f)
+        print("wrote %s (%d trace events)"
+              % (args.perfetto, len(trace["traceEvents"])),
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
