@@ -395,11 +395,16 @@ class StreamingAnalyticsDriver:
                 self._sh_tri = ShardedTriangleWindowKernel(
                     self.mesh, edge_bucket=self.eb,
                     vertex_bucket=self.vb)
-                # every TRIANGLE stream-chunk program compiles at
-                # (re)build time, never mid-stream; the final-flush
-                # analytics programs still first-compile at the flush —
-                # the one violation window scale_run's assert tolerates
-                self._sh_tri.warm_chunks()
+                if self._mesh_live():
+                    # every TRIANGLE stream-chunk program compiles at
+                    # (re)build time, never mid-stream; the final-flush
+                    # analytics programs still first-compile at the
+                    # flush — the one violation window scale_run's
+                    # assert tolerates. A DEMOTED mesh skips the warm:
+                    # compiling against a dead mesh is exactly the
+                    # failure we demoted away from (re-promotion warms
+                    # on first use instead).
+                    self._sh_tri.warm_chunks()
         elif "triangles" in self.analytics:
             self._tri_kernel = tri_ops.TriangleWindowKernel(
                 edge_bucket=self.eb, vertex_bucket=self.vb)
@@ -689,9 +694,12 @@ class StreamingAnalyticsDriver:
 
     def _scan_key(self):
         """Identity of the compiled snapshot-scan program family —
-        bucket growth, analytics, AND the egress format invalidate
-        the cache (a delta program emits a different out tree)."""
-        return (self.vb, self.eb, self.analytics, self._scan_egress())
+        bucket growth, analytics, the egress format (a delta program
+        emits a different out tree) AND mesh liveness (a demotion off
+        the sharded tier switches the family to the single-chip
+        programs; re-promotion switches back) invalidate the cache."""
+        return (self.vb, self.eb, self.analytics, self._scan_egress(),
+                self._mesh_live())
 
     def _scan_egress(self) -> str:
         """The batched scan's d2h egress format: the constructor pin,
@@ -709,7 +717,7 @@ class StreamingAnalyticsDriver:
         (vb, eb, analytics, egress, W-bucket) — O(log) programs
         total."""
         if wb not in self._scan_cache:
-            if self.mesh is not None:
+            if self._mesh_live():
                 from ..parallel.sharded import make_sharded_snapshot_scan
 
                 self._scan_cache[wb] = make_sharded_snapshot_scan(
@@ -777,58 +785,192 @@ class StreamingAnalyticsDriver:
                 if not self._maybe_demote(tier, e):
                     raise
 
+    def _base_tier(self) -> str:
+        """The tier this driver runs on when nothing is demoted: the
+        mesh when one was given, else the pinned/resolved single-chip
+        snapshot tier."""
+        if self.mesh is not None:
+            return "sharded"
+        return self._snapshot_tier or resolve_snapshot_tier()
+
+    def _mesh_live(self) -> bool:
+        """True while the sharded engines are the active tier: a mesh
+        was configured AND no demotion has pushed the stream onto the
+        single-chip ladder (re-promotion restores it)."""
+        return self.mesh is not None and self._demoted_tier is None
+
+    def _mesh_shape(self):
+        """Device counts per mesh axis (degradations provenance);
+        None on a single-chip driver."""
+        if self.mesh is None:
+            return None
+        return [int(x) for x in self.mesh.devices.shape]
+
     def _effective_tier(self) -> str:
         """The snapshot tier the next chunk runs on: a live demotion
         wins over the pin/resolution; after GS_TIER_RETRY_WINDOWS
         windows of probation on the demoted tier, one re-promotion
         probe runs the higher tier again (a repeat failure re-demotes
-        and restarts probation)."""
+        and restarts probation). Re-promoting a mesh session back to
+        the sharded tier pushes the host mirrors — which carried the
+        stream through probation — back into the engine state."""
         if self._demoted_tier is not None:
             n = resilience.tier_retry_windows()
             if n and self.windows_done - self._demoted_at >= n:
+                prev = self._demoted_tier
+                if self.mesh is not None:
+                    # stage the slabs BEFORE declaring the probe: a
+                    # mesh still dead at probe time fails the h2d
+                    # here — record the failed probe, restart
+                    # probation, and stay on the demoted tier (the
+                    # documented repeat-failure-re-demotes contract)
+                    # instead of crashing the stream
+                    try:
+                        self._sync_engine_from_mirrors()
+                    except Exception as e:
+                        if not isinstance(e, (RuntimeError, OSError,
+                                              MemoryError)):
+                            # same filter as _maybe_demote: a semantic
+                            # error is a programming bug, never a dead
+                            # mesh — surface it, don't mask it as a
+                            # failed probe forever
+                            raise
+                        event = resilience.record_demotion(
+                            "snapshot", prev, prev, self.windows_done,
+                            "re-promotion probe failed (%s: %s); "
+                            "probation restarted"
+                            % (type(e).__name__, e),
+                            mesh_shape=self._mesh_shape())
+                        self._demotions.append(event)
+                        self._demoted_at = self.windows_done
+                        return prev
                 event = resilience.record_demotion(
-                    "snapshot", self._demoted_tier,
-                    self._snapshot_tier or resolve_snapshot_tier(),
+                    "snapshot", prev, self._base_tier(),
                     self.windows_done,
                     "re-promotion probe after %d probation windows"
-                    % (self.windows_done - self._demoted_at))
+                    % (self.windows_done - self._demoted_at),
+                    mesh_shape=self._mesh_shape())
                 self._demotions.append(event)
                 if self.timer:
                     self.timer.event("tier_repromotion", event)
                 self._demoted_tier = None
             else:
                 return self._demoted_tier
-        return self._snapshot_tier or resolve_snapshot_tier()
+        return self._base_tier()
 
     def _maybe_demote(self, tier: str, err: BaseException) -> bool:
         """Decide whether `err` on `tier` demotes to the next ladder
         rung. Only failure shapes a tier change can plausibly cure
         demote — stage timeouts and wrapped runtime/OS-level failures
-        (a wedged tunnel, a dead device, an injected fault); semantic
-        errors (ValueError/TypeError/...) re-raise so a programming
-        bug is never silently 'fixed' by falling off the fast tier."""
-        if self.mesh is not None \
-                or not resilience.tier_demotion_enabled():
-            return False  # sharded engines have no host twin
+        (a wedged tunnel, a dead device or shard, an injected fault);
+        semantic errors (ValueError/TypeError/...) re-raise so a
+        programming bug is never silently 'fixed' by falling off the
+        fast tier.
+
+        The full ladder is sharded → single-chip scan → native → host:
+        a mesh session that loses a shard degrades to one device (the
+        engine's gathered replicated state becomes the host mirrors —
+        the same chunk-boundary sources the single-chip rungs re-enter
+        from) instead of wedging; GS_MESH_DEMOTE=0 pins the mesh rung
+        specifically, GS_TIER_DEMOTE=0 pins them all."""
+        if not resilience.tier_demotion_enabled():
+            return False
+        if tier == "sharded" and not resilience.mesh_demotion_enabled():
+            return False
+        cause = err.__cause__
         if not isinstance(err, resilience.StageTimeout):
-            cause = err.__cause__
             if not isinstance(cause, (RuntimeError, OSError,
                                       MemoryError)):
                 return False
-        order = ("scan", "native", "host")
+        order = ("sharded", "scan", "native", "host")
+        shard_id = getattr(cause, "shard", None)
         for nxt in order[order.index(tier) + 1:]:
             if nxt == "native" and not native.snapshot_available():
                 continue
             event = resilience.record_demotion(
                 "snapshot", tier, nxt, self.windows_done,
-                "%s: %s" % (type(err).__name__, err))
+                "%s: %s" % (type(err).__name__, err),
+                mesh_shape=self._mesh_shape(), shard_id=shard_id)
             self._demotions.append(event)
             if self.timer:
                 self.timer.event("tier_demotion", event)
+            if tier == "sharded":
+                # leaving the mesh: the engine's gathered state
+                # becomes the host mirrors every lower rung re-enters
+                # from (and the twin a resumed host session loads)
+                self._absorb_engine_state()
             self._demoted_tier = nxt
             self._demoted_at = self.windows_done
             return True
         return False
+
+    # ------------------------------------------------------------------
+    # mesh ↔ mirror state conversion (the demotion/re-promotion and
+    # cross-mode resume hand-off): the engine's slabs are replicated
+    # (parallel/sharded state_dict docstring), so the gathered copy IS
+    # the single-chip layout up to slicing — degrees/labels [:nv],
+    # cover [:2vb] (both layouts place (−) at vb + v)
+    # ------------------------------------------------------------------
+    def _absorb_engine_state(self, engine_state: dict = None) -> None:
+        """Engine slabs → host mirrors (every sharded finalized
+        boundary, mesh demotion, sharded checkpoint resumed off-mesh).
+        Called with no state at DEMOTION time, the gather from the
+        failing mesh is best-effort only: the batched and per-window
+        sharded paths both refresh the mirrors at their boundaries, so
+        when the d2h itself died with the mesh the mirrors already
+        hold the last finalized state and the demotion proceeds
+        without touching the mesh at all."""
+        st = engine_state
+        if st is None:
+            if self._engine is None:
+                return
+            try:
+                st = self._engine.state_dict()
+            except Exception as e:
+                telemetry.event(
+                    "mesh_gather_failed", durable=True,
+                    window=self.windows_done,
+                    error="%s: %s" % (type(e).__name__, e))
+                return  # mirrors are the truth (see above)
+        nv = len(self.interner)
+        deg = np.asarray(st["degree_state"])
+        self._degrees = deg[:nv].astype(np.int64)
+        self._deg_state = None
+        self._cc = np.asarray(st["labels"])[:nv].astype(np.int32)
+        if "bip_labels" in st:
+            # engine cover is [2vb+2] ((−) at vb + v, two trailing
+            # sentinels); the mirror keeps the [2vb] meaningful run
+            cov = np.asarray(st["bip_labels"])
+            self._bip = cov[:len(cov) - 2].astype(np.int32)
+
+    def _engine_state_from_mirrors(self) -> dict:
+        """Engine-layout state assembled PURELY from the host mirrors
+        — no mesh access, so a demoted session can checkpoint (and a
+        re-promotion can stage its slabs) without touching the dead
+        mesh. Sentinel slots reset to their identities — they absorb
+        padding and feed no output, so results are unchanged."""
+        vb = self.vb
+        st = {"vb": vb, "mesh_shape": self._mesh_shape()}
+        deg = np.zeros(vb + 2, np.int32)
+        deg[:len(self._degrees)] = self._degrees
+        st["degree_state"] = deg
+        lab = np.arange(vb + 2, dtype=np.int32)
+        lab[:len(self._cc)] = self._cc
+        st["labels"] = lab
+        if len(self._bip):
+            if len(self._bip) != 2 * vb:
+                self._bip = self._grow_cover(self._bip, vb)
+            cov = np.arange(2 * vb + 2, dtype=np.int32)
+            cov[:2 * vb] = self._bip
+            st["bip_labels"] = cov
+        return st
+
+    def _sync_engine_from_mirrors(self) -> None:
+        """Host mirrors → engine slabs (mesh re-promotion /
+        single-chip checkpoint resumed onto a mesh)."""
+        if self._engine is None:
+            return
+        self._engine.load_state_dict(self._engine_state_from_mirrors())
 
     def demotion_log(self) -> List[dict]:
         """Demotion/re-promotion events of this driver's lifetime (the
@@ -850,7 +992,11 @@ class StreamingAnalyticsDriver:
         vb = self.vb
         run_scan = any(a in self.analytics
                        for a in ("degrees", "cc", "bipartite"))
-        sharded = self._engine is not None
+        # tier-driven, NOT engine-driven: a mesh session demoted off
+        # the sharded rung runs the single-chip programs against the
+        # host mirrors (populated by _absorb_engine_state) even though
+        # its engine object still exists for the re-promotion path
+        sharded = tier == "sharded"
         # native/host tiers of the snapshot stage: carried union-find
         # + degree fold (C++ or numpy — bit-exact twins) producing the
         # SAME per-window `outs`
@@ -997,6 +1143,12 @@ class StreamingAnalyticsDriver:
                     if "bip_labels" in cur:
                         st["bip_labels"] = cur["bip_labels"]
                 self._engine.load_state_dict(st)
+                # host mirrors track every finalized boundary in
+                # sharded mode too: the demotion hand-off must never
+                # depend on a d2h gather from the very mesh that just
+                # failed, and the rows here are already materialized
+                # host arrays — the copies are O(vb)
+                self._absorb_engine_state(st)
             else:
                 if "deg" in outs:
                     self._degrees = outs["deg"][last][:nv_chunk].astype(
@@ -1196,6 +1348,18 @@ class StreamingAnalyticsDriver:
                     def _disp(s_w=s_w, d_w=d_w, valid=valid,
                               carry_in=carry):
                         faults.fire("dispatch")
+                        if sharded:
+                            # mesh fault hooks + optional wire check
+                            # INSIDE the guarded fn, so a transient
+                            # corrupt wire / stalled dispatch is
+                            # retried with a fresh firing
+                            from ..parallel import sharded as _sh
+                            from ..parallel.mesh import shard_count
+
+                            nsh = shard_count(self.mesh)
+                            s_w, d_w = _sh.guard_wire(
+                                (s_w, d_w), nsh, self.vb + 1)
+                            _sh.fire_shard_dispatch(nsh)
                         return fn(carry_in, jnp.asarray(s_w),
                                   jnp.asarray(d_w), jnp.asarray(valid))
 
@@ -1439,16 +1603,62 @@ class StreamingAnalyticsDriver:
             yield
             pending = self._tri_pending
             if pending:
-                kern = (self._sh_tri if self._engine is not None
-                        else self._tri_kernel)
                 edges = sum(len(s) for _r, s, _d in pending)
+                windows = [(s, d) for _r, s, d in pending]
                 with self._step("triangles", edges):
-                    counts = kern.count_windows(
-                        [(s, d) for _r, s, d in pending])
+                    counts = self._flush_triangle_windows(windows)
                 for (res, _s, _d), c in zip(pending, counts):
                     res.triangles = c
         finally:
             self._tri_pending = None
+
+    def _flush_triangle_windows(self, windows) -> list:
+        """Count the flush's windows down the SAME demotion ladder as
+        the snapshot stage: the sharded kernel while the mesh lives,
+        the single-chip device kernel after a mesh demotion, the
+        pure-numpy twin once the device itself is gone — each rung's
+        typed stage failure demotes and the next rung recounts only
+        the windows the failed rung had not finalized (the sharded
+        kernel drains its finalized counts)."""
+        done: list = []
+        while True:
+            kern = self._tri_kern()
+            try:
+                return done + kern.count_windows(windows[len(done):])
+            except resilience.StageError as e:
+                tier = ("sharded"
+                        if kern is self._sh_tri and self._mesh_live()
+                        else self._demoted_tier or self._base_tier())
+                if not self._maybe_demote(tier, e):
+                    raise
+                done += list(getattr(kern, "drained_counts", None)
+                             or [])
+
+    def _tri_kern(self):
+        """The triangle kernel of the CURRENT tier: the sharded kernel
+        while the mesh is live; the single-chip device kernel after a
+        mesh demotion to the scan tier (built + warmed lazily, rebuilt
+        if buckets grew since); the pure-numpy host twin
+        (parallel/host_twin.HostTriangleWindowKernel) once the session
+        has demoted past the device entirely (native/host rungs) —
+        the availability floor must not compile against the dead
+        backend it demoted away from."""
+        if self._mesh_live() and self._sh_tri is not None:
+            return self._sh_tri
+        if self._demoted_tier in ("native", "host"):
+            k = getattr(self, "_host_tri", None)
+            if k is None or (k.eb, k.vb) != (self.eb, self.vb):
+                from ..parallel.host_twin import HostTriangleWindowKernel
+
+                k = self._host_tri = HostTriangleWindowKernel(
+                    edge_bucket=self.eb, vertex_bucket=self.vb)
+            return k
+        if (self._tri_kernel is None or self._tri_kernel.eb != self.eb
+                or self._tri_kernel.vb != self.vb):
+            self._tri_kernel = tri_ops.TriangleWindowKernel(
+                edge_bucket=self.eb, vertex_bucket=self.vb)
+            self._tri_kernel.warm_chunks()
+        return self._tri_kernel
 
     def _step(self, name: str, num_records: int):
         """Driver step timing: through the StepTimer when tracing is
@@ -1480,7 +1690,7 @@ class StreamingAnalyticsDriver:
         the device scan instead). Single-chip reads the host mirrors;
         sharded syncs the engine state (one extra d2h — the per-window
         path already pays several per window)."""
-        if self._engine is not None:
+        if self._mesh_live() and self._engine is not None:
             st = self._engine.state_dict()
             vb = st["vb"]
             prev = {"deg": np.asarray(st["degree_state"])[:vb].astype(
@@ -1545,7 +1755,7 @@ class StreamingAnalyticsDriver:
                 self._run_one(name, s, d, nv, res)
             else:
                 with self._step(name, len(src)):
-                    self._run_one(name, s, d, nv, res)
+                    self._run_one_laddered(name, s, d, nv, res)
         if prev is not None:
             self._attach_host_deltas(res, prev)
         self.windows_done += 1
@@ -1581,13 +1791,37 @@ class StreamingAnalyticsDriver:
             cover[vb:vb + old_vb] = shifted[old_vb:]
         return cover
 
+    def _run_one_laddered(self, name: str, s: np.ndarray,
+                          d: np.ndarray, nv: int,
+                          res: WindowResult) -> None:
+        """One per-window analytic under the SAME demotion ladder as
+        the batched path: a typed stage failure on the mesh demotes
+        and re-runs THIS analytic on the single-chip tier (the mirrors
+        — refreshed per window — already hold every earlier analytic's
+        state, and the failed dispatch itself is pure-rebind, so the
+        retry never double-applies). Event-time mesh sessions
+        therefore degrade instead of wedging, same as run_arrays."""
+        try:
+            self._run_one(name, s, d, nv, res)
+        except resilience.StageError as e:
+            if not (self._mesh_live()
+                    and self._maybe_demote("sharded", e)):
+                raise
+            self._run_one(name, s, d, nv, res)
+
     def _run_one(self, name: str, s: np.ndarray, d: np.ndarray,
                  nv: int, res: WindowResult) -> None:
-        sharded = self._engine is not None
+        # tier-aware: a mesh session demoted off the sharded rung runs
+        # the single-chip per-window kernels against the host mirrors
+        sharded = self._mesh_live() and self._engine is not None
         if name == "degrees":
             if sharded:
                 snap = np.array(self._engine.degrees(s, d)[:nv])
                 self._check_degree_width(snap)
+                # mirror tracks every window boundary (host-side copy
+                # of an already-gathered array): the demotion hand-off
+                # never needs a gather from a failing mesh
+                self._degrees = snap.astype(np.int64)
                 res.degrees = _snapshot_view(snap)
             else:
                 import jax.numpy as jnp
@@ -1622,8 +1856,9 @@ class StreamingAnalyticsDriver:
                 res.degrees = _snapshot_view(snap.copy())
         elif name == "cc":
             if sharded:
-                res.cc_labels = _snapshot_view(
-                    np.array(self._engine.cc_labels(s, d)[:nv]))
+                lab = np.array(self._engine.cc_labels(s, d)[:nv])
+                self._cc = lab.copy()  # mirror: see degrees above
+                res.cc_labels = _snapshot_view(lab)
             else:
                 if len(self._cc) < nv:
                     self._cc = np.concatenate([
@@ -1636,6 +1871,12 @@ class StreamingAnalyticsDriver:
         elif name == "bipartite":
             if sharded:
                 _, _, odd = self._engine.bipartite(s, d)
+                # mirror: see degrees above (one extra d2h of the
+                # cover labels — the per-window path already pays
+                # several per window)
+                self._bip = np.asarray(
+                    self._engine._bip_labels)[:2 * self.vb].astype(
+                        np.int32)
                 res.bipartite_odd = _snapshot_view(np.array(odd[:nv]))
             else:
                 # cover layout is VERTEX-BUCKET based ((+) = v,
@@ -1660,10 +1901,8 @@ class StreamingAnalyticsDriver:
                 # latency through a tunneled chip ~0.2s dominates)
                 self._tri_pending.append(
                     (res, np.asarray(s, np.int32), np.asarray(d, np.int32)))
-            elif sharded:
-                res.triangles = self._sh_tri.count(s, d)
             else:
-                res.triangles = self._tri_kernel.count(s, d)
+                res.triangles = self._tri_kern().count(s, d)
 
     # ------------------------------------------------------------------
     # checkpoint / resume + failure recovery (utils/checkpoint.py)
@@ -1746,6 +1985,7 @@ class StreamingAnalyticsDriver:
             "window_ms": self.window_ms,
             "analytics": list(self.analytics),
             "sharded": self.mesh is not None,
+            "mesh_shape": self._mesh_shape(),
             "windows_done": self.windows_done,
             "edges_done": self.edges_done,
             "edge_bucket": self.eb,
@@ -1757,7 +1997,13 @@ class StreamingAnalyticsDriver:
             "bip": self._bip.copy(),
         }
         if self._engine is not None:
-            state["engine"] = self._engine.state_dict()
+            # demoted mesh session: the host mirrors carried the
+            # stream since the demotion — the checkpoint assembles
+            # the engine slabs from them on the HOST, never touching
+            # (or persisting stale state from) the dead mesh
+            state["engine"] = (self._engine.state_dict()
+                               if self._mesh_live()
+                               else self._engine_state_from_mirrors())
         if getattr(self, "_scan_tuner", None) is not None:
             # the learned dispatch configuration rides the checkpoint
             # so a resumed stream keeps its optimum (ops/autotune)
@@ -1771,15 +2017,6 @@ class StreamingAnalyticsDriver:
             raise ValueError(
                 f"analytics mismatch: checkpoint has "
                 f"{state['analytics']}, driver runs {list(self.analytics)}")
-        # .get: checkpoints from before this key carried host-array state
-        if state.get("sharded", False) != (self.mesh is not None):
-            # carried state lives in different representations (host
-            # arrays vs engine device state); refuse rather than resume
-            # from silently-empty analytics
-            raise ValueError(
-                "checkpoint was taken in "
-                + ("sharded" if state["sharded"] else "single-chip")
-                + " mode; construct the driver in the same mode to resume")
         self.interner = make_interner(np.array([0]))
         self._ext_ids = np.zeros(0, np.int64)
         self.windows_done = int(state.get("windows_done", 0))
@@ -1816,8 +2053,23 @@ class StreamingAnalyticsDriver:
         self._cc = np.array(state["cc"])
         self._bip = np.array(state["bip"])
         self._ensure_buckets(len(state["vertex_ids"]), 1)
-        if self._engine is not None and "engine" in state:
-            self._engine.load_state_dict(state["engine"])
+        # cross-MODE resume: the engine's carried slabs are gathered
+        # replicated state (shard-count independent — parallel/sharded
+        # state_dict), so a mesh checkpoint converts to the single-chip
+        # mirrors and vice versa. A 4-shard checkpoint therefore
+        # resumes on any mesh width, on 1 device, or on the host tier.
+        ckpt_sharded = bool(state.get("sharded", False))
+        if self._engine is not None:
+            if "engine" in state:
+                self._engine.load_state_dict(state["engine"])
+            elif not ckpt_sharded:
+                # single-chip checkpoint onto a mesh: mirrors → slabs
+                self._sync_engine_from_mirrors()
+        elif ckpt_sharded and "engine" in state:
+            # mesh checkpoint onto a single-chip driver: slabs →
+            # mirrors (the checkpointed mirrors are empty in sharded
+            # mode — the engine state is the truth)
+            self._absorb_engine_state(state["engine"])
         # .get: checkpoints predating the autotune key restore cleanly;
         # with GS_AUTOTUNE=0 the state is carried nowhere (inert)
         if state.get("autotune") is not None and self.mesh is None:

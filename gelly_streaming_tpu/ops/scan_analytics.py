@@ -122,11 +122,26 @@ class SummaryEngineBase:
             # high-water mark would suppress every due() until the new
             # stream re-passed it (same fix as the driver's reset)
             self._ckpt_policy.mark(0)
-        self._carry = (
+        self._carry = self._init_carry()
+
+    def _init_carry(self):
+        """Fresh carried state in the shared layout (degrees [vb+1],
+        cc labels [vb+1], double cover [2(vb+1)]; sentinel slot vb).
+        Device engines carry jnp arrays; the numpy host twin
+        (parallel/host_twin.HostSummaryEngine) overrides this and
+        `_to_carry` to stay off the device entirely — the layout (and
+        therefore checkpoint interchangeability) is identical."""
+        return (
             jnp.zeros(self.vb + 1, jnp.int32),
             jnp.arange(self.vb + 1, dtype=jnp.int32),
             jnp.arange(2 * (self.vb + 1), dtype=jnp.int32),
         )
+
+    def _to_carry(self, a):
+        """Lift one restored checkpoint leaf into this engine's carry
+        representation (device array by default; numpy on the host
+        twin)."""
+        return jnp.asarray(a)
 
     def state(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(degrees[vb], cc_labels[vb], odd[vb]) snapshots."""
@@ -168,7 +183,7 @@ class SummaryEngineBase:
                                      self.eb, self.vb))
         self.windows_done = int(state["windows_done"])
         self._closed_partial = bool(state["closed_partial"])
-        self._carry = tuple(jnp.asarray(a) for a in state["carry"])
+        self._carry = tuple(self._to_carry(a) for a in state["carry"])
         # .get: checkpoints from before the autotune key (and engines
         # with the tuner off) restore without it
         if state.get("autotune") is not None and self.AUTOTUNE:

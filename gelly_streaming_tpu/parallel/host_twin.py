@@ -1,0 +1,289 @@
+"""Host/native twins of the sharded engines — the mesh's demotion
+floor and CPU parity oracle.
+
+The single-chip path earned its twin ladder in the resilience round
+(device scan → native C++ → `ops/host_snapshot.py` numpy); the sharded
+engines had none: a multi-chip session that lost its mesh WEDGED
+instead of demoting (ROADMAP, ISSUE 2 note). This module closes that
+hole with bit-exact equivalents of `parallel/sharded.py`'s three
+engines, reconstructed from the gathered per-shard slabs the same way
+`ops/host_snapshot.py` twins the single-chip scan:
+
+- `HostWindowEngine`        — numpy `ShardedWindowEngine`
+- `HostTriangleWindowKernel`— numpy `ShardedTriangleWindowKernel`
+- `HostSummaryEngine`       — numpy `ShardedSummaryEngine` (and
+                              therefore also of the single-chip
+                              `StreamSummaryEngine`: the carried
+                              layout is shared)
+
+Gathering is trivial BY CONSTRUCTION: every sharded merge ends in a
+psum/pmin/pmax, so the carried slabs are replicated across the mesh
+and one d2h of shard 0's copy is already the shard-count-independent
+host layout. A checkpoint taken on a 4-way mesh therefore loads into
+a 1-device engine or any of these twins unchanged, and a twin's
+checkpoint loads back onto any mesh — the cross-mesh resume contract
+`tests/test_checkpoint_roundtrip.py` pins.
+
+Bit-exactness is by construction, not coincidence (the
+`ops/host_snapshot.py` argument): degrees are integer sums, triangle
+counts are exact in every tier (`ops/host_triangles.window_count`,
+the pure-numpy form of the count every sharded ladder rung must
+reproduce), and the carried min-label
+fixpoints converge to the canonical labeling whatever the iteration
+schedule — `host_snapshot._fixpoint` replays the same scatter-min +
+pointer-jump rounds in numpy. The one deliberate divergence: the
+sharded DEGREE kernel folds its mesh padding into the [vb+2] slab's
+sentinel slot (a mesh-width-dependent count), which the twins leave at
+zero — the slot feeds no output and no `[:vb]` read, and baking a mesh
+width into a host twin would break exactly the shard-count
+independence the twins exist for.
+
+These twins exist for availability and verification, not speed: no
+compiler, no device, no mesh — only numpy. A stream that lands here is
+degraded and LABELED as such (`utils/resilience.record_demotion`
+carries the mesh shape into PERF.json's `degradations` section). They
+double as the CPU-mesh parity oracle the planned Pallas ICI
+collectives verify against (ROADMAP).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..ops import host_triangles, scan_analytics
+from ..ops import segment as seg_ops
+from ..ops import unionfind
+from ..ops.host_snapshot import _fixpoint
+
+
+# ----------------------------------------------------------------------
+# ShardedWindowEngine twin
+# ----------------------------------------------------------------------
+
+class HostWindowEngine:
+    """Numpy twin of `ShardedWindowEngine`: same per-window analytics
+    (running degrees, carried min-label CC, double-cover
+    bipartiteness), same carried-state layouts (`degree_state`/`labels`
+    [vb+2], `bip_labels` [2vb+2]) and `state_dict` keys, so state
+    hands off in BOTH directions across any mesh width."""
+
+    def __init__(self, num_vertices_bucket: int = 1 << 16):
+        self.vb = num_vertices_bucket
+        self.reset()
+
+    @classmethod
+    def from_sharded(cls, engine) -> "HostWindowEngine":
+        """Twin a live `ShardedWindowEngine`, adopting its gathered
+        state — the mid-stream demotion hand-off."""
+        twin = cls(num_vertices_bucket=engine.vb)
+        twin.load_state_dict(engine.state_dict())
+        return twin
+
+    def reset(self) -> None:
+        self._degree_state = np.zeros(self.vb + 2, np.int32)
+        self._labels = np.arange(self.vb + 2, dtype=np.int32)
+        self._bip_labels: Optional[np.ndarray] = None
+
+    @staticmethod
+    def _edges(src, dst):
+        return (np.asarray(src, np.int64).ravel(),
+                np.asarray(dst, np.int64).ravel())
+
+    def degrees(self, src, dst) -> np.ndarray:
+        s, d = self._edges(src, dst)
+        np.add.at(self._degree_state, s, 1)
+        np.add.at(self._degree_state, d, 1)
+        return self._degree_state[: self.vb].copy()
+
+    def cc_labels(self, src, dst, carry: bool = True) -> np.ndarray:
+        s, d = self._edges(src, dst)
+        labels = (self._labels if carry
+                  else np.arange(self.vb + 2, dtype=np.int32))
+        self._labels = _fixpoint(labels, s, d)
+        return self._labels[: self.vb].copy()
+
+    def bipartite(self, src, dst, carry: bool = True):
+        fresh = np.arange(2 * self.vb + 2, dtype=np.int32)
+        labels = (self._bip_labels
+                  if (carry and self._bip_labels is not None)
+                  else fresh)
+        s, d = self._edges(src, dst)
+        s2, d2 = unionfind.double_cover_edges(s, d, self.vb)
+        self._bip_labels = _fixpoint(labels, s2, d2)
+        return unionfind.decode_double_cover(self._bip_labels, self.vb)
+
+    def state_dict(self) -> dict:
+        state = {
+            "vb": self.vb,
+            "mesh_shape": None,  # the twin IS the no-mesh floor
+            "degree_state": self._degree_state.copy(),
+            "labels": self._labels.copy(),
+        }
+        if self._bip_labels is not None:
+            state["bip_labels"] = self._bip_labels.copy()
+        return state
+
+    def load_state_dict(self, state: dict) -> None:
+        if state["vb"] != self.vb:
+            raise ValueError(
+                f"vertex bucket mismatch: checkpoint has {state['vb']}, "
+                f"twin built with {self.vb}")
+        self._degree_state = np.asarray(state["degree_state"],
+                                        np.int32).copy()
+        self._labels = np.asarray(state["labels"], np.int32).copy()
+        self._bip_labels = (np.asarray(state["bip_labels"],
+                                       np.int32).copy()
+                            if "bip_labels" in state else None)
+
+
+# ----------------------------------------------------------------------
+# ShardedTriangleWindowKernel twin
+# ----------------------------------------------------------------------
+
+class HostTriangleWindowKernel:
+    """Numpy twin of `ShardedTriangleWindowKernel`: exact per-window
+    triangle counts via the pure-numpy window counter
+    (`ops/host_triangles.window_count` — no device, no compiler, same
+    counts as every ladder rung), with the twin constructed at the
+    SHARDED kernel's resolved buckets so window boundaries cut
+    identically. Stateless, like its twin."""
+
+    def __init__(self, edge_bucket: int, vertex_bucket: int):
+        # verbatim buckets: from_sharded passes the mesh kernel's
+        # already-resolved (eb, vb) so `count_stream` cuts the exact
+        # same tumbling windows; standalone use buckets like the
+        # single-chip kernel does
+        self.eb = int(edge_bucket)
+        self.vb = int(vertex_bucket)
+
+    @classmethod
+    def from_sharded(cls, kernel) -> "HostTriangleWindowKernel":
+        return cls(edge_bucket=kernel.eb, vertex_bucket=kernel.vb)
+
+    def count(self, src: np.ndarray, dst: np.ndarray) -> int:
+        if len(src) > self.eb:
+            raise ValueError(f"window of {len(src)} edges exceeds edge "
+                             f"bucket {self.eb}")
+        return host_triangles.window_count(src, dst)
+
+    def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
+        src = np.asarray(src, np.int32)
+        dst = np.asarray(dst, np.int32)
+        eb = self.eb
+        return [self.count(src[i:i + eb], dst[i:i + eb])
+                for i in range(0, len(src), eb)]
+
+    def count_windows(self, windows) -> list:
+        return [self.count(np.asarray(s), np.asarray(d))
+                for s, d in windows]
+
+
+# ----------------------------------------------------------------------
+# ShardedSummaryEngine / StreamSummaryEngine twin
+# ----------------------------------------------------------------------
+
+class HostSummaryEngine(scan_analytics.SummaryEngineBase):
+    """Numpy twin of the fused summary engines: the same
+    `SummaryEngineBase` chunk loop, window cuts, checkpoint layout and
+    summary dicts, with every device stage replaced by a host fold —
+    `_h2d` is the identity, `_dispatch_async` replays the scan body
+    per window row in numpy (degrees fold, `_fixpoint` min-label CC
+    and double cover, exact sparse triangle count), and overflow
+    signals are identically zero (the host count is already exact).
+    The carry is plain numpy (`_init_carry`/`_to_carry` overrides), so
+    the twin runs with no compiler and no live device — the demotion
+    floor of a mesh session, loadable straight from a
+    `ShardedSummaryEngine` (or single-chip) checkpoint of equal
+    buckets."""
+
+    AUTOTUNE = False
+    TUNABLE_INGRESS = False
+    ingress = "standard"
+
+    def __init__(self, edge_bucket: int, vertex_bucket: int,
+                 k_bucket: int = 0):
+        # k_bucket accepted (and ignored) for constructor parity with
+        # the engines this twin stands in for: the host count has no K
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self.vb = seg_ops.bucket_size(vertex_bucket)
+        self.reset()
+
+    @classmethod
+    def from_state(cls, state: dict) -> "HostSummaryEngine":
+        """Build a twin directly from a fused-engine checkpoint state
+        (sharded or single-chip — the layout is shared) and adopt it."""
+        twin = cls(edge_bucket=int(state["edge_bucket"]),
+                   vertex_bucket=int(state["vertex_bucket"]))
+        twin.load_state_dict(state)
+        return twin
+
+    @classmethod
+    def from_sharded(cls, engine) -> "HostSummaryEngine":
+        """Twin a live `ShardedSummaryEngine` mid-stream: gather its
+        replicated carry and continue from `resume_offset()` — the
+        demotion hand-off (combine with `engine.drained_partial` after
+        an escaping error)."""
+        return cls.from_state(engine.state_dict())
+
+    # -- carry representation: numpy, never the device ---------------
+    def _init_carry(self):
+        return (
+            np.zeros(self.vb + 1, np.int32),
+            np.arange(self.vb + 1, dtype=np.int32),
+            np.arange(2 * (self.vb + 1), dtype=np.int32),
+        )
+
+    def _to_carry(self, a):
+        return np.asarray(a).copy()
+
+    # -- the host "device" stages -------------------------------------
+    def _h2d(self, args):
+        return args
+
+    def _dispatch_async(self, s, d, valid):
+        vb = self.vb
+        deg, labels, cover = (a.copy() for a in self._carry)
+        s = np.asarray(s)
+        d = np.asarray(d)
+        valid = np.asarray(valid)
+        num_w = s.shape[0]
+        mdeg = np.zeros(num_w, np.int32)
+        ncomp = np.zeros(num_w, np.int32)
+        odd = np.zeros(num_w, bool)
+        tri = np.zeros(num_w, np.int64)
+        vidx = np.arange(vb)
+        for i in range(num_w):
+            v = valid[i]
+            # sentinel-mapped edges, exactly as the scan body's
+            # jnp.where(valid, src, sent): cc sees (vb, vb) self-loops
+            # (no-ops), the cover sees the (vb, 2vb+1) sentinel join
+            si = np.where(v, s[i], vb).astype(np.int64)
+            di = np.where(v, d[i], vb).astype(np.int64)
+            # degrees: padding contributes ZERO on device (masked
+            # ones), so only real edges fold here — bit-exact slabs
+            np.add.at(deg, si[v], 1)
+            np.add.at(deg, di[v], 1)
+            mdeg[i] = deg[:vb].max() if vb else 0
+            labels = _fixpoint(labels, si, di)
+            touched = deg[:vb] > 0
+            ncomp[i] = int(np.sum(touched & (labels[:vb] == vidx)))
+            cover = _fixpoint(
+                cover,
+                np.concatenate([si, si + (vb + 1)]),
+                np.concatenate([di + (vb + 1), di]))
+            odd[i] = bool(np.any(
+                touched & (cover[:vb] == cover[vb + 1:2 * vb + 1])))
+            tri[i] = host_triangles.window_count(s[i][v], d[i][v])
+        self._carry = (deg, labels, cover)
+        zeros = np.zeros(num_w, np.int32)
+        return mdeg, ncomp, odd, tri, zeros, zeros
+
+    def _materialize(self, raw):
+        return tuple(np.asarray(x) for x in raw)
+
+    def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
+        # unreachable in normal operation (the host fold never
+        # overflows); kept exact for warm_fallback and API parity
+        return host_triangles.window_count(src, dst)

@@ -37,6 +37,83 @@ from .mesh import (SHARD_AXIS, make_mesh, mesh_padded_len,
 from ..ops import ingress_pipeline, scan_analytics
 from ..ops import segment as seg_ops
 from ..ops import triangles, unionfind
+from ..utils import faults, resilience, telemetry
+
+
+# ----------------------------------------------------------------------
+# mesh fault hooks + stage guards (utils/faults, utils/resilience)
+#
+# The single-chip stages earned their watchdogs and fault sites in the
+# resilience round; these are the mesh-scoped twins: every sharded
+# shard_map dispatch, replicated-output gather, and h2d wire passes a
+# hook a fault plan can poison (dead shard / ICI stall / corrupt
+# wire), and dispatches run under resilience.call_guarded when the
+# stage knobs are armed. With no plan and inert knobs every helper is
+# one dict lookup (or a plain call) — the hot path is unchanged.
+# ----------------------------------------------------------------------
+
+def fire_shard_dispatch(n_shards: int) -> None:
+    """Fault hook before a sharded shard_map dispatch. One firing per
+    SPMD dispatch (not per shard): a dead chip fails the whole
+    program, so the fault plan models shard death via FaultSpec.shard
+    metadata, not per-shard call counting."""
+    faults.fire("shard_dispatch", n_shards)
+
+
+def fire_shard_gather(n_shards: int) -> None:
+    """Fault hook before the d2h gather of replicated sharded outputs
+    (window stacks, engine state slabs)."""
+    faults.fire("shard_gather", n_shards)
+
+
+def guard_wire(arrays, n_shards: int, limit: int):
+    """The mesh h2d wire hook: run the arrays through the fault plan
+    (corrupt_shard poisons one shard's slice) and, when
+    GS_MESH_WIRE_CHECK=1, validate every shard slice's vertex ids
+    against `limit` (the bucket sentinel — the largest id any honest
+    stack can carry). Returns the (possibly fault-transformed) arrays;
+    raises RuntimeError naming the offending shard on a corrupt wire,
+    which the stage guards surface as a typed StageFailed feeding the
+    demotion ladder."""
+    payload = faults.fire("shard_wire", (tuple(arrays), n_shards))
+    if isinstance(payload, tuple) and len(payload) == 2:
+        arrays = payload[0]
+    if resilience.mesh_wire_check_enabled():
+        _check_wire(arrays, n_shards, limit)
+    return arrays
+
+
+def _check_wire(arrays, n_shards: int, limit: int) -> None:
+    """Range-check each shard's slice of the mesh-bound stacks: any
+    integer id above `limit` is a corrupt wire (ids are interned dense
+    slots ≤ bucket sentinel by construction)."""
+    for a in arrays:
+        a = np.asarray(a)
+        if not np.issubdtype(a.dtype, np.integer) or not a.size:
+            continue
+        width = a.shape[-1] // n_shards
+        if not width:
+            continue
+        for k in range(n_shards):
+            sl = a[..., k * width:(k + 1) * width]
+            if sl.size and int(sl.max()) > limit:
+                raise RuntimeError(
+                    "corrupt shard wire: shard %d of %d carries vertex"
+                    " id %d > bucket sentinel %d (GS_MESH_WIRE_CHECK)"
+                    % (k, n_shards, int(sl.max()), limit))
+
+
+def _guarded_dispatch(chunk, fn, retries=None):
+    """Run one PURE sharded dispatch under the stage watchdog/retry
+    policy when the knobs are armed (resilience.call_guarded —
+    retryable because every guarded sharded dispatch is
+    carry-in/carry-out pure and rebinds state only on success); the
+    bare call otherwise — exact legacy behavior and exception types
+    with inert knobs."""
+    if resilience.guard_active():
+        return resilience.call_guarded("dispatch", chunk, fn,
+                                       retries=retries)
+    return fn()
 
 
 # ----------------------------------------------------------------------
@@ -585,6 +662,9 @@ class ShardedTriangleWindowKernel:
         # per-stage counters of the shared ingress pipeline (same
         # contract as TriangleWindowKernel.stage_timers)
         self.stage_timers = ingress_pipeline.StageTimers()
+        # counts finalized before the last escaping _run_stack error
+        # (None = clean): the demoting caller's re-entry cursor
+        self.drained_counts = None
         self._fns = {}
 
     def _fn(self, kb, cap):
@@ -622,8 +702,17 @@ class ShardedTriangleWindowKernel:
         kb = self._next_kb(failed_kb) if failed_kb else self.kb
         cap = self._next_cap(failed_cap) if failed_cap else self.cap
         while True:
-            count, bucket_ovf, k_ovf = self._fn(kb, cap)(s, d, valid)
-            bucket_ovf, k_ovf = int(bucket_ovf), int(k_ovf)
+            def _disp(kb=kb, cap=cap):
+                # fire + MATERIALIZE inside the guard: a dead shard's
+                # dispatch failure and a hung gather both surface as
+                # the typed stage error the demotion ladder feeds on
+                fire_shard_dispatch(self.n)
+                got = self._fn(kb, cap)(s, d, valid)
+                fire_shard_gather(self.n)
+                return tuple(int(x) for x in got)
+
+            count, bucket_ovf, k_ovf = _guarded_dispatch(
+                ("sharded_window", kb, cap), _disp)
             if not bucket_ovf and not k_ovf:
                 return int(count)
             kb_sat = kb >= self.kb_max
@@ -692,10 +781,18 @@ class ShardedTriangleWindowKernel:
         `get_window(w)` returns the raw (src, dst) of window w for the
         rare exact overflow recount. Ragged final chunks pad the
         window axis to a power-of-two bucket so varying stream lengths
-        reuse O(log) compiled programs."""
+        reuse O(log) compiled programs.
+
+        On ANY escaping error the pipeline drains the in-flight
+        chunk's finalize first (ops/ingress_pipeline), and the counts
+        finalized before the failure are stashed on
+        `self.drained_counts` — the re-entry cursor a demoting caller
+        (core/driver, the host twin hand-off) combines with, instead
+        of recomputing delivered windows."""
         sharding = self._chunk_sharding()
         num_w = s.shape[0]
         counts: list = []
+        self.drained_counts = None
 
         def prep(at):
             hi = min(at + self.MAX_STREAM_WINDOWS, num_w)
@@ -706,16 +803,21 @@ class ShardedTriangleWindowKernel:
 
         def h2d(payload):
             at, n, args = payload
+            sc, dc = guard_wire(args[:2], self.n, self.vb)
             return at, n, tuple(jax.device_put(a, sharding)
-                                for a in args)
+                                for a in (sc, dc) + args[2:])
 
         def dispatch(dev_payload):
             at, n, dev = dev_payload
+            fire_shard_dispatch(self.n)
+            telemetry.event("sharded.round", engine="triangles",
+                            window=at, windows=n, mesh=self.n)
             fn = self._stream_exec(dev[0].shape[0])
             return (at, n) + tuple(fn(*dev))
 
         def finalize(raw):
             at, n = raw[:2]
+            fire_shard_gather(self.n)
             # np.array (not asarray): device outputs are read-only views
             c, b_ovf, k_ovf = (np.array(x)[:n] for x in raw[2:])
             for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
@@ -726,9 +828,14 @@ class ShardedTriangleWindowKernel:
                     failed_cap=self.cap if int(b_ovf[w]) else 0)
             counts.extend(int(x) for x in c)
 
-        ingress_pipeline.run_pipeline(
-            range(0, num_w, self.MAX_STREAM_WINDOWS),
-            prep, h2d, dispatch, finalize, timers=self.stage_timers)
+        try:
+            ingress_pipeline.run_pipeline(
+                range(0, num_w, self.MAX_STREAM_WINDOWS),
+                prep, h2d, dispatch, finalize,
+                timers=self.stage_timers)
+        except Exception:
+            self.drained_counts = list(counts)
+            raise
         return counts
 
     def count_stream(self, src: np.ndarray, dst: np.ndarray) -> list:
@@ -745,9 +852,13 @@ class ShardedTriangleWindowKernel:
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
                                                   sentinel=self.vb)
         eb = self.eb
-        return self._run_stack(
-            s, d, valid,
-            lambda w: (src[w * eb:(w + 1) * eb], dst[w * eb:(w + 1) * eb]))
+        with telemetry.span("sharded.stream", tier="sharded",
+                            engine="triangles", mesh=self.n,
+                            windows=num_w, edges=len(src)):
+            return self._run_stack(
+                s, d, valid,
+                lambda w: (src[w * eb:(w + 1) * eb],
+                           dst[w * eb:(w + 1) * eb]))
 
     def count_windows(self, windows) -> list:
         """Exact counts of a list of (src, dst) window batches of
@@ -759,7 +870,11 @@ class ShardedTriangleWindowKernel:
             return []
         s, d, valid = seg_ops.stack_window_list(windows, self.eb,
                                                 self.vb)
-        return self._run_stack(s, d, valid, lambda w: windows[w])
+        with telemetry.span("sharded.stream", tier="sharded",
+                            engine="triangles", mesh=self.n,
+                            windows=len(windows),
+                            edges=sum(len(w[0]) for w in windows)):
+            return self._run_stack(s, d, valid, lambda w: windows[w])
 
 
 # ----------------------------------------------------------------------
@@ -775,6 +890,7 @@ class ShardedWindowEngine:
 
     def __init__(self, mesh=None, num_vertices_bucket: int = 1 << 16):
         self.mesh = mesh if mesh is not None else make_mesh()
+        self.n = shard_count(self.mesh)
         self.vb = num_vertices_bucket
         self.degree_fn = make_sharded_degree_fn(self.mesh, self.vb)
         self.cc_fn = make_sharded_cc_fn(self.mesh, self.vb)
@@ -796,19 +912,39 @@ class ShardedWindowEngine:
         self._labels = jnp.arange(self.vb + 2, dtype=jnp.int32)
         self._bip_labels = None
 
-    def _prep(self, src, dst):
+    def _prep(self, src, dst, sentinel: int = None):
         src, dst = pad_edges_for_mesh(
             np.asarray(src, np.int32), np.asarray(dst, np.int32),
-            self.mesh, sentinel=self.vb + 1,
+            self.mesh,
+            sentinel=self.vb + 1 if sentinel is None else sentinel,
         )
+        src, dst = guard_wire(
+            (src, dst), self.n,
+            self.vb + 1 if sentinel is None else sentinel)
         return jnp.asarray(src), jnp.asarray(dst)
+
+    def _dispatch(self, analytic: str, edges: int, fn):
+        """One per-window sharded dispatch: tier-labeled span, the
+        shard_dispatch fault hook, and the stage watchdog/retry when
+        armed (every fn here is pure — state rebinds on success
+        only)."""
+        def _call():
+            fire_shard_dispatch(self.n)
+            return fn()
+
+        with telemetry.span("sharded.window", tier="sharded",
+                            analytic=analytic, mesh=self.n,
+                            edges=edges):
+            return _guarded_dispatch(("sharded", analytic), _call)
 
     def degrees(self, src, dst) -> np.ndarray:
         """Fold a window batch into the running degree vector."""
         s, d = self._prep(src, dst)
         # sentinel slot vb+1 absorbs padding; the kernel buckets to vb+1
         # rows plus sentinel, so state length is vb+2
-        self._degree_state = self.degree_fn(s, d, self._degree_state)
+        self._degree_state = self._dispatch(
+            "degrees", len(np.atleast_1d(src)),
+            lambda: self.degree_fn(s, d, self._degree_state))
         return np.asarray(self._degree_state[: self.vb])
 
     def cc_labels(self, src, dst, carry: bool = True) -> np.ndarray:
@@ -818,7 +954,9 @@ class ShardedWindowEngine:
         labels = self._labels if carry else jnp.arange(
             self.vb + 2, dtype=jnp.int32
         )
-        self._labels = self.cc_fn(s, d, labels)
+        self._labels = self._dispatch(
+            "cc", len(np.atleast_1d(src)),
+            lambda: self.cc_fn(s, d, labels))
         return np.asarray(self._labels[: self.vb])
 
     def bipartite(self, src, dst, carry: bool = True):
@@ -841,8 +979,11 @@ class ShardedWindowEngine:
         s2, d2 = pad_edges_for_mesh(s2.astype(np.int32),
                                     d2.astype(np.int32), self.mesh,
                                     sentinel=2 * self.vb + 1)
-        self._bip_labels = self._bip_fn(jnp.asarray(s2), jnp.asarray(d2),
-                                        labels)
+        s2, d2 = guard_wire((s2, d2), self.n, 2 * self.vb + 1)
+        s2j, d2j = jnp.asarray(s2), jnp.asarray(d2)
+        self._bip_labels = self._dispatch(
+            "bipartite", len(np.atleast_1d(src)),
+            lambda: self._bip_fn(s2j, d2j, labels))
         return unionfind.decode_double_cover(
             np.asarray(self._bip_labels), self.vb)
 
@@ -850,8 +991,17 @@ class ShardedWindowEngine:
     # checkpoint / resume (utils/checkpoint.py)
     # ------------------------------------------------------------------
     def state_dict(self) -> dict:
+        """Gathered-host snapshot of the carried analytics state. The
+        slabs are REPLICATED across the mesh (every merge ends in a
+        psum/pmin), so the d2h gather of shard 0's copy IS the
+        shard-count-independent layout: a checkpoint taken on a 4-way
+        mesh loads into any mesh width, the single-chip driver
+        mirrors, or the numpy twin (parallel/host_twin) unchanged.
+        `mesh_shape` records provenance only — load ignores it."""
+        fire_shard_gather(self.n)
         state = {
             "vb": self.vb,
+            "mesh_shape": [self.n],
             "degree_state": np.asarray(self._degree_state),
             "labels": np.asarray(self._labels),
         }
@@ -886,8 +1036,12 @@ class ShardedWindowEngine:
             (np.asarray(ea, np.int32), sentinel),
             (np.asarray(eb, np.int32), sentinel),
             (np.asarray(emask, bool), False))
-        return int(self.tri_fn(jnp.asarray(nbr), jnp.asarray(ea),
-                               jnp.asarray(eb), jnp.asarray(emask)))
+        ea, eb = guard_wire((ea, eb), self.n, sentinel)
+        nbr_j, ea_j, eb_j, em_j = (jnp.asarray(nbr), jnp.asarray(ea),
+                                   jnp.asarray(eb), jnp.asarray(emask))
+        return self._dispatch(
+            "triangles", len(ea),
+            lambda: int(self.tri_fn(nbr_j, ea_j, eb_j, em_j)))
 
     def sliding_reduce(self, src, pane, val, num_panes: int,
                        panes_per_window: int, name: str = "sum",
@@ -927,8 +1081,11 @@ class ShardedWindowEngine:
         src, pane, val, valid = self._pad_mesh_arrays(
             target, (src, 0), (pane, 0), (val, 0),
             (np.ones(n, bool), False))
-        wv, wc = pane_fn(jnp.asarray(src), jnp.asarray(pane),
-                         jnp.asarray(val), jnp.asarray(valid))
+        src, pane = guard_wire((src, pane), self.n,
+                               max(self.vb, pb))
+        args = tuple(jnp.asarray(a) for a in (src, pane, val, valid))
+        wv, wc = self._dispatch("sliding_reduce", n,
+                                lambda: pane_fn(*args))
         return np.asarray(wv), np.asarray(wc)
 
 
@@ -1092,11 +1249,15 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
     def __init__(self, mesh, edge_bucket: int, vertex_bucket: int,
                  k_bucket: int = 0):
         self.mesh = mesh
+        self.n = shard_count(mesh)
         self._tri = ShardedTriangleWindowKernel(
             mesh, edge_bucket=edge_bucket, vertex_bucket=vertex_bucket,
             k_bucket=k_bucket)
         self.eb = self._tri.eb
         self.vb = self._tri.vb
+        # summaries finalized before the last escaping process() error
+        # (None = clean): the demoting caller's hand-off — see process
+        self.drained_partial = None
         # same compile-size cap as the single-chip FUSED engine — this
         # is the multi-analytic scan program class that wedges the
         # remote compiler at sizes the triangle program compiles (the
@@ -1113,14 +1274,21 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
     def _h2d(self, args):
         from jax.sharding import NamedSharding
 
+        s, d = guard_wire(args[:2], self.n, self.vb)
         sharding = NamedSharding(self.mesh, P(None, SHARD_AXIS))
-        return tuple(jax.device_put(a, sharding) for a in args)
+        return tuple(jax.device_put(a, sharding)
+                     for a in (s, d) + tuple(args[2:]))
 
     def _dispatch_async(self, s, d, valid):
+        fire_shard_dispatch(self.n)
+        telemetry.event("sharded.round", engine="summary",
+                        window=self.windows_done, windows=s.shape[0],
+                        mesh=self.n)
         self._carry, res = self._run(self._carry, s, d, valid)
         return res
 
     def _materialize(self, raw):
+        fire_shard_gather(self.n)
         return tuple(np.array(x) for x in raw)
 
     def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
@@ -1128,3 +1296,46 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
             src, dst,
             failed_kb=self._tri.kb if k_ovf else 0,
             failed_cap=self._tri.cap if b_ovf else 0)
+
+    # ------------------------------------------------------------------
+    # error-path drain + mesh provenance
+    # ------------------------------------------------------------------
+    def _finalize_summaries(self, item, src, dst, out) -> None:
+        # tee the finalized prefix by ALIAS (out only ever grows, and
+        # process() snapshots it on the error path): the drain stays
+        # O(W) total, not O(W²) of per-chunk copies
+        super()._finalize_summaries(item, src, dst, out)
+        self._partial_out = out
+
+    def process(self, src: np.ndarray, dst: np.ndarray) -> list:
+        """SummaryEngineBase.process over the mesh, with the sharded
+        drain contract: when an error escapes (dead shard, ICI stall,
+        corrupt wire), the in-flight chunk's finalize has already
+        drained (ops/ingress_pipeline) and the summaries of every
+        window finalized before the failure land on
+        `self.drained_partial` — windows_done/resume_offset() then sit
+        exactly past them, so a caller can hand the drained prefix
+        over and re-enter on a twin (parallel/host_twin) or a fresh
+        mesh from the last finalized window instead of recomputing
+        delivered work."""
+        self._partial_out = []
+        self.drained_partial = None
+        with telemetry.span("sharded.stream", tier="sharded",
+                            engine="summary", mesh=self.n,
+                            edges=len(np.atleast_1d(src))):
+            try:
+                return super().process(src, dst)
+            except Exception:
+                self.drained_partial = list(self._partial_out)
+                raise
+            finally:
+                self._partial_out = []
+
+    def state_dict(self) -> dict:
+        fire_shard_gather(self.n)
+        state = super().state_dict()
+        # provenance only (load ignores it): the carry d2h'd above is
+        # replicated, so the layout is shard-count independent — the
+        # single-chip engine and the host twin load it unchanged
+        state["mesh_shape"] = [self.n]
+        return state

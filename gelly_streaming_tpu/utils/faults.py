@@ -40,6 +40,26 @@ dict lookup on the hot path):
     parse         io/sources edge-chunk parse (payload=bytes;
                   corrupt_bytes garbles one line)
 
+Mesh-scoped sites (fired only by the sharded engines and the driver's
+mesh path — parallel/sharded.py; a single-chip run never fires them,
+which is what lets a demoted stream keep running through a plan that
+keeps killing the mesh):
+
+    shard_dispatch  every sharded shard_map dispatch (the SPMD program
+                    covering ALL shards — a dead chip fails the whole
+                    dispatch, so `raise` here with FaultSpec.shard=k
+                    models shard k dying: the InjectedFault carries
+                    the shard id for the demotion record; `hang`
+                    models an ICI stall the GS_STAGE_TIMEOUT_S
+                    watchdog must cut)
+    shard_gather    the d2h gather of replicated sharded outputs /
+                    engine state slabs
+    shard_wire      the mesh h2d wire; payload=(arrays, n_shards).
+                    corrupt_shard garbles FaultSpec.shard's slice of
+                    each array's edge axis — GS_MESH_WIRE_CHECK=1
+                    (utils/resilience.mesh_wire_check_enabled) is the
+                    guard that must catch it before dispatch.
+
 Actions:
     raise          raise InjectedFault (or `exc` if given). fatal=True
                    marks the fault non-retryable: the stage guards
@@ -50,6 +70,11 @@ Actions:
     truncate_file  payload is a path: cut the file to half its bytes.
     corrupt_bytes  payload is bytes: garble the first line-break-free
                    span (models a torn/overwritten edge line).
+    corrupt_shard  payload is (arrays, n_shards): poison shard
+                   `spec.shard`'s contiguous slice of each array's
+                   trailing (edge) axis with out-of-range vertex ids —
+                   a torn/garbled ICI wire that MUST be caught by the
+                   wire check, never silently folded.
     call           invoke `fn(payload)` and return its result — the
                    escape hatch for bespoke corruption.
 """
@@ -68,12 +93,16 @@ from . import telemetry
 class InjectedFault(RuntimeError):
     """A fault raised by the active plan. `site` names the hook that
     fired; `fatal` marks it exempt from stage-guard retries (the
-    simulated hard kill)."""
+    simulated hard kill); `shard` (mesh-scoped sites) names the shard
+    the fault implicates — the driver's demotion record carries it
+    into the `degradations` evidence as `shard_id`."""
 
-    def __init__(self, message: str, site: str, fatal: bool = False):
+    def __init__(self, message: str, site: str, fatal: bool = False,
+                 shard: Optional[int] = None):
         super().__init__(message)
         self.site = site
         self.fatal = fatal
+        self.shard = shard
 
 
 @dataclasses.dataclass
@@ -89,6 +118,7 @@ class FaultSpec:
     exc: Optional[type] = None    # raise: exception class to use
     fatal: bool = False           # raise: exempt from guard retries
     fn: Optional[Callable] = None  # call: bespoke payload transform
+    shard: Optional[int] = None   # mesh sites: implicated shard id
 
     def _matches(self, call_no: int) -> bool:
         return self.on_call <= call_no < self.on_call + self.times
@@ -118,7 +148,7 @@ class FaultPlan:
         for s in hits:
             telemetry.event("fault_injected", durable=s.fatal,
                             site=site, call=n, action=s.action,
-                            fatal=s.fatal)
+                            fatal=s.fatal, shard=s.shard)
         # act OUTSIDE the lock: a hang must not serialize other sites
         for s in hits:
             payload = _act(s, site, n, payload)
@@ -134,11 +164,14 @@ def _act(spec: FaultSpec, site: str, call_no: int, payload):
             # tools/chaos_run.py and tests/test_telemetry.py assert
             telemetry.on_fatal(site)
         exc = spec.exc
+        where = ("site %r (call %d)" % (site, call_no)
+                 if spec.shard is None else
+                 "site %r (call %d, shard %d)"
+                 % (site, call_no, spec.shard))
         if exc is None:
-            raise InjectedFault(
-                "injected fault at site %r (call %d)" % (site, call_no),
-                site, fatal=spec.fatal)
-        raise exc("injected fault at site %r (call %d)" % (site, call_no))
+            raise InjectedFault("injected fault at " + where, site,
+                                fatal=spec.fatal, shard=spec.shard)
+        raise exc("injected fault at " + where)
     if spec.action == "hang":
         time.sleep(spec.seconds)
         return payload
@@ -148,6 +181,23 @@ def _act(spec: FaultSpec, site: str, call_no: int, payload):
             f.seek(0, 2)
             f.truncate(f.tell() // 2)
         return payload
+    if spec.action == "corrupt_shard":
+        import numpy as np
+
+        arrays, n = payload
+        k = spec.shard or 0
+        poisoned = []
+        for a in arrays:
+            a = np.array(a)  # fresh copy: never poison caller state
+            width = a.shape[-1] // n
+            if width and np.issubdtype(a.dtype, np.integer):
+                # out-of-range vertex ids (far above any bucket's
+                # sentinel): the wire check must trip, the scatter
+                # kernels must never silently fold them
+                a[..., k * width:(k + 1) * width] = np.iinfo(
+                    a.dtype).max
+            poisoned.append(a)
+        return tuple(poisoned), n
     if spec.action == "corrupt_bytes":
         data = bytearray(payload)
         # garble the first line: digits -> 'x' makes the parser drop

@@ -220,17 +220,29 @@ _DEMOTIONS_LOCK = threading.Lock()
 
 
 def record_demotion(component: str, from_tier: str, to_tier: str,
-                    window: int, reason: str) -> dict:
+                    window: int, reason: str,
+                    mesh_shape: Optional[list] = None,
+                    shard_id: Optional[int] = None) -> dict:
     """Log one tier demotion (or a failed re-promotion probe). The
     process-global log is what tools/profile_kernels.py snapshots into
     PERF.json's `degradations` section, so a run that silently fell
-    off the device tier is labeled in the committed evidence."""
+    off the device tier is labeled in the committed evidence.
+
+    `mesh_shape` (device counts per mesh axis; None = single-chip) and
+    `shard_id` (the implicated shard of a mesh failure, when known —
+    e.g. faults.InjectedFault.shard) are ALWAYS present in the event:
+    a demoted mesh run must carry its mesh provenance into the
+    degradations evidence, so it can never masquerade as a healthy
+    sharded-tier row (tools/perf_schema.py enforces the key)."""
     event = {
         "component": component,
         "from": from_tier,
         "to": to_tier,
         "window": int(window),
         "reason": reason[:500],
+        "mesh_shape": (None if mesh_shape is None
+                       else [int(x) for x in mesh_shape]),
+        "shard_id": None if shard_id is None else int(shard_id),
     }
     with _DEMOTIONS_LOCK:
         _DEMOTIONS.append(event)
@@ -269,3 +281,24 @@ def tier_demotion_enabled() -> bool:
     demoted bench row is worse than a failed one; the profiler also
     labels any demotion that does happen)."""
     return os.environ.get("GS_TIER_DEMOTE", "1") != "0"
+
+
+def mesh_demotion_enabled() -> bool:
+    """GS_MESH_DEMOTE=0 pins a sharded session to the mesh: a
+    persistent mesh failure raises instead of demoting
+    sharded → single-chip scan → native → host (subordinate to
+    GS_TIER_DEMOTE, which pins EVERY rung). Default 1: a dead shard
+    degrades the stream to one device instead of wedging it — the
+    multi-chip leg of the core/driver demotion ladder."""
+    return os.environ.get("GS_MESH_DEMOTE", "1") != "0"
+
+
+def mesh_wire_check_enabled() -> bool:
+    """GS_MESH_WIRE_CHECK=1 arms the sharded h2d wire validation
+    (parallel/sharded.guard_wire): every mesh-bound window stack is
+    range-checked per shard slice before dispatch, so a corrupt shard
+    wire (torn transfer, faults.py's corrupt_shard drill) surfaces as
+    a typed stage failure naming the shard instead of scattering
+    out-of-range ids into carried state. Default 0: the hot path
+    stays byte-identical to the unguarded form."""
+    return os.environ.get("GS_MESH_WIRE_CHECK", "0") == "1"

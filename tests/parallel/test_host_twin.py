@@ -1,0 +1,156 @@
+"""Host twins of the sharded engines (parallel/host_twin) — bit-exact
+parity with their mesh originals on the virtual CPU mesh, plus the
+gathered-state hand-off that makes them the mesh's demotion floor.
+Outputs (and the `[:vb]` meaningful state) are the parity surface;
+carry SENTINEL slots are deliberately excluded where documented
+(they absorb call-pattern-dependent padding in every engine)."""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+from gelly_streaming_tpu.parallel.host_twin import (
+    HostSummaryEngine, HostTriangleWindowKernel, HostWindowEngine)
+from gelly_streaming_tpu.parallel.mesh import make_mesh
+from gelly_streaming_tpu.parallel.sharded import (
+    ShardedSummaryEngine, ShardedTriangleWindowKernel,
+    ShardedWindowEngine)
+
+
+def _edges(rng, v, n):
+    return (rng.integers(0, v, n).astype(np.int32),
+            rng.integers(0, v, n).astype(np.int32))
+
+
+def test_window_engine_twin_matches_sharded():
+    rng = np.random.default_rng(3)
+    vb = 64
+    sh = ShardedWindowEngine(make_mesh(4), num_vertices_bucket=vb)
+    tw = HostWindowEngine(num_vertices_bucket=vb)
+    for _ in range(3):  # carried state across windows
+        s, d = _edges(rng, 50, 200)
+        np.testing.assert_array_equal(sh.degrees(s, d),
+                                      tw.degrees(s, d))
+        np.testing.assert_array_equal(sh.cc_labels(s, d),
+                                      tw.cc_labels(s, d))
+        for a, b in zip(sh.bipartite(s, d), tw.bipartite(s, d)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_window_engine_state_hands_off_both_ways():
+    """Mid-stream demotion shape: gathered sharded state → twin, twin
+    state → a fresh sharded engine; both continue identically."""
+    rng = np.random.default_rng(4)
+    vb = 64
+    sh = ShardedWindowEngine(make_mesh(4), num_vertices_bucket=vb)
+    s, d = _edges(rng, 50, 300)
+    sh.degrees(s, d)
+    sh.cc_labels(s, d)
+    sh.bipartite(s, d)
+
+    tw = HostWindowEngine.from_sharded(sh)
+    s2, d2 = _edges(rng, 50, 300)
+    np.testing.assert_array_equal(sh.cc_labels(s2, d2),
+                                  tw.cc_labels(s2, d2))
+    np.testing.assert_array_equal(sh.degrees(s2, d2),
+                                  tw.degrees(s2, d2))
+
+    # twin → mesh: the re-promotion direction (twin state carries the
+    # extra windows the sharded engine above also folded)
+    back = ShardedWindowEngine(make_mesh(2), num_vertices_bucket=vb)
+    back.load_state_dict(tw.state_dict())
+    s3, d3 = _edges(rng, 50, 200)
+    np.testing.assert_array_equal(back.cc_labels(s3, d3),
+                                  tw.cc_labels(s3, d3))
+
+
+def test_triangle_kernel_twin_matches_sharded():
+    rng = np.random.default_rng(5)
+    k = ShardedTriangleWindowKernel(make_mesh(4), edge_bucket=256,
+                                    vertex_bucket=64)
+    tw = HostTriangleWindowKernel.from_sharded(k)
+    assert (tw.eb, tw.vb) == (k.eb, k.vb)  # identical window cuts
+    s, d = _edges(rng, 60, 1000)
+    assert k.count_stream(s, d) == tw.count_stream(s, d)
+    wins = [(_edges(rng, 60, n)) for n in (5, 100, 256)]
+    assert k.count_windows(wins) == tw.count_windows(wins)
+    with pytest.raises(ValueError, match="exceeds edge bucket"):
+        tw.count(np.zeros(tw.eb + 1, np.int32),
+                 np.ones(tw.eb + 1, np.int32))
+
+
+def test_summary_twin_matches_both_engines():
+    """HostSummaryEngine == StreamSummaryEngine == ShardedSummaryEngine
+    summary-for-summary, including a hub-overflow window (the sharded
+    path recounts it; the host fold is exact outright)."""
+    rng = np.random.default_rng(23)
+    n, v, eb = 1536, 200, 256
+    src, dst = _edges(rng, v, n)
+    # splice a 30-clique into window 2 to force a sharded K overflow
+    cl_s, cl_d = [], []
+    for u in range(1, 31):
+        for w in range(u + 1, 31):
+            cl_s.append(u)
+            cl_d.append(w)
+    src[2 * eb:2 * eb + len(cl_s[:eb])] = cl_s[:eb]
+    dst[2 * eb:2 * eb + len(cl_d[:eb])] = cl_d[:eb]
+
+    want = StreamSummaryEngine(edge_bucket=eb,
+                               vertex_bucket=v).process(src, dst)
+    host = HostSummaryEngine(edge_bucket=eb, vertex_bucket=v)
+    assert host.process(src, dst) == want
+    sh = ShardedSummaryEngine(make_mesh(4), edge_bucket=eb,
+                              vertex_bucket=v, k_bucket=8)
+    assert sh.process(src, dst) == want
+    # the twins' visible state agrees too
+    hd, hl, ho = host.state()
+    sd, sl, so = sh.state()
+    np.testing.assert_array_equal(hd[:v], sd[:v])
+    np.testing.assert_array_equal(hl[:v], sl[:v])
+    np.testing.assert_array_equal(ho[:v], so[:v])
+
+
+def test_summary_twin_resumes_sharded_mid_stream():
+    """The demotion hand-off: fold half the stream on the mesh, hand
+    the gathered carry to the twin, continue — combined summaries
+    equal the uninterrupted single-chip run."""
+    rng = np.random.default_rng(11)
+    eb, v = 256, 200
+    src, dst = _edges(rng, v, 2048)
+    want = StreamSummaryEngine(edge_bucket=eb,
+                               vertex_bucket=v).process(src, dst)
+    sh = ShardedSummaryEngine(make_mesh(4), edge_bucket=eb,
+                              vertex_bucket=v)
+    head = sh.process(src[:1024], dst[:1024])
+    tw = HostSummaryEngine.from_sharded(sh)
+    off = tw.resume_offset()
+    assert off == 1024
+    tail = tw.process(src[off:], dst[off:])
+    assert head + tail == want
+
+
+def test_summary_twin_needs_no_device_dispatch(monkeypatch):
+    """The twin must stay a pure-host path (it exists for sessions
+    whose device/mesh is DEAD): compute the oracle first, then poison
+    the jax dispatch entry points and run the twin through a full
+    stream, checkpoint save included."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(2)
+    eb, v = 128, 100
+    src, dst = _edges(rng, v, 512)
+    want = StreamSummaryEngine(edge_bucket=eb,
+                               vertex_bucket=v).process(src, dst)
+
+    def boom(*a, **k):
+        raise AssertionError("host twin dispatched to the device")
+
+    monkeypatch.setattr(jax, "device_put", boom)
+    monkeypatch.setattr(jax, "jit", boom)
+    monkeypatch.setattr(jnp, "asarray", boom)
+    tw = HostSummaryEngine(edge_bucket=eb, vertex_bucket=v)
+    assert tw.process(src, dst) == want
+    state = tw.state_dict()  # the gather is host-side too
+    tw2 = HostSummaryEngine.from_state(state)
+    assert tw2.windows_done == tw.windows_done

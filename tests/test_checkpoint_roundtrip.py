@@ -150,6 +150,79 @@ def test_sharded_engine_state_roundtrip_through_file(tmp_path):
     assert np.array_equal(np.asarray(ga), np.asarray(gb))
 
 
+def test_driver_mesh_checkpoint_resumes_on_one_device_and_host(
+        tmp_path):
+    """Cross-MESH resume, driver level: a checkpoint taken on a 4-way
+    mesh resumes bit-exactly on 1 device (scan tier) AND on the numpy
+    host tier — the engine slabs are gathered replicated state, so
+    they convert to the single-chip mirrors on load."""
+    from gelly_streaming_tpu.parallel.mesh import make_mesh
+
+    src, dst = _stream(n=8 * 512, v=700)
+
+    def mk(**kw):
+        return StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=512, vertex_bucket=1024,
+            analytics=("degrees", "cc", "bipartite", "triangles"),
+            **kw)
+
+    full = _key(mk().run_arrays(src, dst))
+    a = mk(mesh=make_mesh(4))
+    head = _key(a.run_arrays(src[:4 * 512], dst[:4 * 512]))
+    path = str(tmp_path / "mesh.npz")
+    ck.save(path, a.state_dict())
+    for tier in ("scan", "host"):
+        b = mk(snapshot_tier=tier)
+        assert b.try_resume(path)
+        off = b.edges_done
+        tail = _key(b.run_arrays(src[off:], dst[off:]))
+        assert head + tail == full, tier
+    # and the other direction: a single-chip checkpoint onto a mesh
+    c = mk()
+    head2 = _key(c.run_arrays(src[:4 * 512], dst[:4 * 512]))
+    path2 = str(tmp_path / "single.npz")
+    ck.save(path2, c.state_dict())
+    d = mk(mesh=make_mesh(4))
+    assert d.try_resume(path2)
+    tail2 = _key(d.run_arrays(src[d.edges_done:], dst[d.edges_done:]))
+    assert head2 + tail2 == full
+
+
+def test_sharded_summary_checkpoint_cross_mesh_and_twin(tmp_path):
+    """Cross-MESH resume, engine level: a 4-shard ShardedSummaryEngine
+    checkpoint (through the npz format) continues bit-exactly on the
+    single-chip engine, on the numpy host twin, and on a 2-shard mesh
+    — the shard-count-independent gathered layout."""
+    from gelly_streaming_tpu.parallel.host_twin import HostSummaryEngine
+    from gelly_streaming_tpu.parallel.mesh import make_mesh
+    from gelly_streaming_tpu.parallel.sharded import ShardedSummaryEngine
+
+    src, dst = _stream(n=2048, v=200)
+    src32, dst32 = src.astype(np.int32), dst.astype(np.int32)
+    eb, vb = 256, 256
+    full = StreamSummaryEngine(edge_bucket=eb,
+                               vertex_bucket=vb).process(src32, dst32)
+    a = ShardedSummaryEngine(make_mesh(4), edge_bucket=eb,
+                             vertex_bucket=vb)
+    head = a.process(src32[:4 * eb], dst32[:4 * eb])
+    assert a.state_dict()["mesh_shape"] == [4]
+    path = str(tmp_path / "sh4.npz")
+    ck.save(path, a.state_dict())
+
+    resumers = [
+        StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb),
+        HostSummaryEngine(edge_bucket=eb, vertex_bucket=vb),
+        ShardedSummaryEngine(make_mesh(2), edge_bucket=eb,
+                             vertex_bucket=vb),
+    ]
+    for eng in resumers:
+        assert eng.try_resume(path), type(eng).__name__
+        off = eng.resume_offset()
+        assert off == 4 * eb
+        tail = eng.process(src32[off:], dst32[off:])
+        assert head + tail == full, type(eng).__name__
+
+
 def test_disjoint_set_roundtrip_through_file(tmp_path):
     edges = [(1, 2), (3, 4), (2, 3), (7, 8), (9, 7), (4, 9)]
     full = DisjointSet()

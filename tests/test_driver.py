@@ -127,14 +127,22 @@ def test_driver_tracing_and_file(tmp_path):
     assert {row["op"] for row in report} >= {"intern", "triangles"}
 
 
-def test_driver_cross_mode_checkpoint_refused():
+def test_driver_cross_mode_checkpoint_converts():
+    """A single-chip checkpoint now CONVERTS onto a mesh driver (and
+    vice versa — the engine slabs are gathered replicated state): the
+    resumed sharded session continues with the checkpointed analytics
+    instead of refusing. Full round-trip equality is pinned by
+    tests/test_checkpoint_roundtrip.py's cross-mode suite."""
     a = StreamingAnalyticsDriver(window_ms=500)
     a.run_arrays(np.array([1, 2]), np.array([2, 3]),
                  np.array([100, 200]))
     state = a.state_dict()
     b = StreamingAnalyticsDriver(window_ms=500, mesh=make_mesh())
-    with pytest.raises(ValueError, match="single-chip mode"):
-        b.load_state_dict(state)
+    b.load_state_dict(state)
+    assert b.windows_done == a.windows_done
+    st = b._engine.state_dict()
+    np.testing.assert_array_equal(
+        np.asarray(st["degree_state"])[:len(a._degrees)], a._degrees)
 
 
 def test_driver_auto_checkpoint_failure_recovery(tmp_path):

@@ -45,7 +45,10 @@ def test_schema_rejects_malformed_sections():
         "backend": "cpu",
         "ingress_ab": {"not": "a list"},
         "egress_ab": [{"probe": "driver_ab", "parity": True}],  # no speedup
-        "degradations": [{"from": "scan"}],        # missing to/window
+        "degradations": [{"from": "scan"},  # missing to/window/mesh
+                         {"from": "sharded", "to": "scan", "window": 1,
+                          "mesh_shape": "4x1",     # not a list of ints
+                          "shard_id": "two"}],     # not an int
         "pipeline_stages": ["not-a-dict"],
         "host_reduce_error": "not-a-dict",
         "telemetry": [{"count": 3}],               # missing span
@@ -55,6 +58,7 @@ def test_schema_rejects_malformed_sections():
     assert "ingress_ab" in joined
     assert "egress_ab" in joined and "speedup" in joined
     assert "degradations" in joined
+    assert "'mesh_shape'" in joined and "'shard_id'" in joined
     assert "pipeline_stages" in joined
     assert "host_reduce_error" in joined
     assert "telemetry" in joined and "'span'" in joined
@@ -114,7 +118,11 @@ FIXTURE = {
                   "chosen": {"wb": 64, "kb": 32,
                              "ingress": "standard"}}],
     "degradations": [{"section": "driver", "from": "scan",
-                      "to": "native", "window": 5, "reason": "t"}],
+                      "to": "native", "window": 5, "reason": "t",
+                      "mesh_shape": None, "shard_id": None},
+                     {"section": "driver", "from": "sharded",
+                      "to": "scan", "window": 9, "reason": "dead shard",
+                      "mesh_shape": [4], "shard_id": 2}],
     "telemetry": [{"span": "ingress.prep", "count": 16,
                    "total_ms": 40.0, "p50_ms": 2.0, "p95_ms": 4.0,
                    "p99_ms": 5.0}],

@@ -25,14 +25,29 @@ Schedule (all deterministic, utils/faults — no randomness anywhere):
             · 1 fatal kill mid-call → fresh engine resumes from its
               auto-checkpoint, positional combine
 
+  leg M — the MESH drill (virtual n-device CPU mesh, armed via
+          --mesh-devices; the process pins a CPU backend with that
+          many virtual devices before jax initializes): a sharded
+          driver streamed with
+            · 1 corrupt shard wire        (GS_MESH_WIRE_CHECK=1
+                                           catches it; retried clean)
+            · 1 DEAD SHARD mid-stream     (persistent shard_dispatch
+                                           failure) → the sharded →
+              single-chip-scan demotion ladder re-enters from the
+              last finalized chunk, and the final window-by-window
+              digests still equal the fault-free single-chip oracle
+          plus the cross-mesh-shape resume proof: a checkpoint taken
+          on the n-way mesh resumes bit-exactly on 1 device (scan
+          tier) AND on the numpy host tier.
+
 The tool FAILS unless (a) every fault class actually fired somewhere,
-and (b) both legs' outputs are bit-identical (sha256 over the full
+and (b) every leg's outputs are bit-identical (sha256 over the full
 snapshot arrays, not just scalars) to their fault-free twins.
 
 Usage:
   python tools/chaos_run.py [--edges 524288] [--eb 32768]
                             [--vertices 65536] [--engine-windows N]
-                            [--out CHAOS.json]
+                            [--mesh-devices 4] [--out CHAOS.json]
 """
 
 import argparse
@@ -277,6 +292,113 @@ def leg_autotune(path: str, eb: int, num_w: int, workdir: str) -> dict:
                 os.environ[k] = v
 
 
+def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
+             workdir: str) -> dict:
+    """The mesh drill: a sharded driver on the virtual CPU mesh takes
+    a corrupt shard wire (caught by GS_MESH_WIRE_CHECK, retried clean)
+    and then loses a shard for good (persistent shard_dispatch
+    failure) — the sharded → single-chip-scan demotion ladder must
+    re-enter from the last finalized chunk and the final digests must
+    equal the fault-free single-chip oracle window by window. Then the
+    cross-mesh-shape resume proof: a checkpoint taken on the n-way
+    mesh resumes bit-exactly on 1 device (scan tier) AND on the numpy
+    host tier."""
+    from gelly_streaming_tpu.parallel.mesh import make_mesh
+    from gelly_streaming_tpu.utils import checkpoint as ck
+
+    def make(mesh=None, **kw):
+        return StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=eb, vertex_bucket=vb,
+            analytics=("degrees", "cc", "bipartite", "triangles"),
+            mesh=mesh, **kw)
+
+    def digests(results):
+        return [_digest(r) for r in results]
+
+    mesh = make_mesh(n_shards)
+    src, dst = make_stream(num_w * eb, vb // 2, seed=29)
+    # the single-chip run IS the oracle; the fault-free mesh run must
+    # already match it (the twin-parity contract)
+    baseline = digests(make().run_arrays(src, dst))
+    if digests(make(mesh=mesh).run_arrays(src, dst)) != baseline:
+        raise SystemExit("chaos mesh leg: fault-free sharded run "
+                         "diverged from the single-chip oracle")
+
+    # the sharded scan first-compiles on the CPU mesh inside its
+    # guarded dispatch: the drill needs a deadline that cuts a real
+    # stall, not a compile (leg A owns the tight-deadline watchdog
+    # proof)
+    env_prev = {k: os.environ.get(k)
+                for k in ("GS_STAGE_TIMEOUT_S", "GS_MESH_WIRE_CHECK")}
+    os.environ["GS_STAGE_TIMEOUT_S"] = "30"
+    os.environ["GS_MESH_WIRE_CHECK"] = "1"
+    half = max(2, num_w // 2)
+    try:
+        demoted_before = len(resilience.demotion_events())
+        drv = make(mesh=mesh)
+        plan_specs = [
+            faults.FaultSpec(site="shard_wire", on_call=1, times=1,
+                             action="corrupt_shard", shard=1),
+            faults.FaultSpec(site="shard_dispatch", on_call=2,
+                             times=1 << 20, shard=2),  # THE DEAD SHARD
+        ]
+        with faults.inject(*plan_specs) as plan:
+            got = digests(drv.run_arrays(src[:half * eb],
+                                         dst[:half * eb]))
+            got += digests(drv.run_arrays(src[half * eb:],
+                                          dst[half * eb:]))
+        if got != baseline:
+            raise SystemExit(
+                "chaos mesh leg DIVERGED from the fault-free run")
+        fired = list(plan.fired)
+        if not any(s == "shard_wire" for s, _n, _a in fired):
+            raise SystemExit("chaos mesh leg: the corrupt wire never "
+                             "fired (fired=%r)" % fired)
+        demos = resilience.demotion_events()[demoted_before:]
+        dead = [e for e in demos if e["from"] == "sharded"
+                and e["shard_id"] == 2]
+        if not dead:
+            raise SystemExit("chaos mesh leg: the dead shard never "
+                             "demoted the mesh (demotions=%r)" % demos)
+        if dead[0]["mesh_shape"] != [n_shards]:
+            raise SystemExit("chaos mesh leg: demotion lost its mesh "
+                             "shape: %r" % dead[0])
+
+        # ---- cross-mesh-shape resume: n-shard ckpt → 1 device + host
+        ckpt = os.path.join(workdir, "mesh.npz")
+        a = make(mesh=mesh)
+        head = digests(a.run_arrays(src[:half * eb], dst[:half * eb]))
+        ck.save(ckpt, a.state_dict())
+        resumed_tiers = []
+        for tier in ("scan", "host"):
+            b = make(snapshot_tier=tier)  # mesh=None: 1 device / numpy
+            if not b.try_resume(ckpt):
+                raise SystemExit("chaos mesh leg: %s-tier resume found "
+                                 "no checkpoint" % tier)
+            off = b.edges_done
+            tail = digests(b.run_arrays(src[off:], dst[off:]))
+            if head + tail != baseline:
+                raise SystemExit(
+                    "chaos mesh leg: %s-tier resume of the %d-shard "
+                    "checkpoint diverged" % (tier, n_shards))
+            resumed_tiers.append(tier)
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    return {
+        "windows": num_w,
+        "mesh_shape": [n_shards],
+        "faults_fired": [list(f) for f in fired],
+        "demotions": demos,
+        "cross_mesh_resume_tiers": resumed_tiers,
+        "parity": True,
+    }
+
+
 def assert_flight_recorder(num_kills: int) -> dict:
     """The flight-recorder durability leg: after the kill→resume
     drills, the run ledger (utils/telemetry, armed by main) must hold
@@ -348,9 +470,25 @@ def main():
                     "parity proof lives in leg A; leg B contributes "
                     "the h2d/kill fault classes at a bucket the "
                     "deadline fits")
+    ap.add_argument("--mesh-devices", type=int, default=4,
+                    help="virtual CPU devices for the mesh drill "
+                    "(pins a CPU backend with that many devices "
+                    "before jax initializes; 0 skips the mesh leg "
+                    "and leaves the backend untouched)")
+    ap.add_argument("--mesh-eb", type=int, default=2048,
+                    help="mesh-leg edge bucket (the sharded CPU scan "
+                    "bounds the soak; the row-scale parity proof is "
+                    "leg A's)")
+    ap.add_argument("--mesh-windows", type=int, default=8)
     ap.add_argument("--out", default=None,
                     help="write the JSON summary here")
     args = ap.parse_args()
+
+    if args.mesh_devices:
+        # must precede the first jax computation in this process
+        from gelly_streaming_tpu.core.platform import cpu_mesh
+
+        cpu_mesh(args.mesh_devices)
 
     for k, v in KNOBS.items():
         os.environ.setdefault(k, v)
@@ -386,6 +524,11 @@ def main():
                 seed=13)
             b = leg_engine(b_src, b_dst, args.engine_eb, engine_vb,
                            args.engine_windows, workdir)
+            # mesh leg: corrupt wire → retry, dead shard → demotion →
+            # parity, n-shard checkpoint → 1-device + host-twin resume
+            m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
+                          args.mesh_devices, workdir)
+                 if args.mesh_devices else None)
             # flight-recorder leg: three kills fired above (driver,
             # autotune, engine) — the ledger must prove all of them
             fr = assert_flight_recorder(num_kills=3)
@@ -407,8 +550,18 @@ def main():
                 classes.add("prep_failure")
             elif action == "raise":
                 classes.add("kill_resume")
-    missing = {"prep_failure", "h2d_timeout_retry",
-               "kill_resume"} - classes
+    required = {"prep_failure", "h2d_timeout_retry", "kill_resume"}
+    if m is not None:
+        for site, _n, action in m["faults_fired"]:
+            if action == "corrupt_shard":
+                classes.add("shard_wire_corrupt_retry")
+            elif site == "shard_dispatch" and action == "raise":
+                classes.add("dead_shard_demotion")
+        if m["cross_mesh_resume_tiers"] == ["scan", "host"]:
+            classes.add("cross_mesh_resume")
+        required |= {"shard_wire_corrupt_retry", "dead_shard_demotion",
+                     "cross_mesh_resume"}
+    missing = required - classes
     if missing:
         raise SystemExit("chaos schedule incomplete: %s never fired"
                          % sorted(missing))
@@ -418,6 +571,7 @@ def main():
         "vertices": args.vertices,
         "knobs": KNOBS,
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
+        "mesh_leg": m,
         "flight_recorder_leg": fr,
         "fault_classes_fired": sorted(classes),
         "demotions": resilience.demotion_events(),

@@ -41,7 +41,11 @@ LIST_SECTIONS = {
     "chunk_deep": ("edge_bucket",),
     "compile_probe": ("program", "slots", "ok"),
     "compile_probe_scan": ("program", "slots", "ok"),
-    "degradations": ("from", "to", "window"),
+    # mesh_shape is REQUIRED (null = single-chip): a demoted mesh run
+    # must carry its mesh provenance, so it can never masquerade as a
+    # healthy sharded-tier row (utils/resilience.record_demotion is
+    # the single producer and always stamps it, with shard_id beside)
+    "degradations": ("from", "to", "window", "mesh_shape"),
     "ingress_probes": ("probe",),
     # flight-recorder summary rows (utils/telemetry.summary():
     # per-span latency aggregates a profiler/chaos run commits)
@@ -73,6 +77,19 @@ def _check_rows(name: str, rows, errors) -> None:
                 errors.append(
                     "%s[%d]: parity-true row needs a positive "
                     "'speedup' (got %r)" % (name, i, sp))
+        if name == "degradations":
+            ms = row.get("mesh_shape")
+            if ms is not None and not (
+                    isinstance(ms, list)
+                    and all(isinstance(x, int) for x in ms)):
+                errors.append(
+                    "degradations[%d]: 'mesh_shape' must be null or a "
+                    "list of ints (got %r)" % (i, ms))
+            sid = row.get("shard_id")
+            if sid is not None and not isinstance(sid, int):
+                errors.append(
+                    "degradations[%d]: 'shard_id' must be null or an "
+                    "int (got %r)" % (i, sid))
 
 
 def validate(perf) -> list:
