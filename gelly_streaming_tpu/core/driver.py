@@ -213,8 +213,10 @@ def resolve_snapshot_tier() -> str:
                     "native_edges_per_s", "scan_edges_per_s")
                     and native.snapshot_available()):
                 tier = "native"
-    except Exception:
-        pass
+    except Exception as e:
+        telemetry.event("selection.fallback", durable=True,
+                        component="snapshot_tier", fallback=tier,
+                        error="%s: %s" % (type(e).__name__, e))
     _SNAPSHOT_TIER = tier
     return tier
 
@@ -1393,8 +1395,16 @@ class StreamingAnalyticsDriver:
             # demotion re-entry (and an operator resume) exact
             try:
                 finalize_pending()
-            except Exception:
+            except Exception as drain_err:
                 pending = None
+                try:
+                    telemetry.event(
+                        "drain_failed", durable=True,
+                        component="driver",
+                        error="%s: %s" % (type(drain_err).__name__,
+                                          drain_err))
+                except Exception:  # gslint: disable=except-hygiene (a failing ledger write must not replace the typed StageError the demotion ladder keys on)
+                    pass
             raise
         finalize_pending()
         _meas_flush()
@@ -1985,7 +1995,7 @@ class StreamingAnalyticsDriver:
             "window_ms": self.window_ms,
             "analytics": list(self.analytics),
             "sharded": self.mesh is not None,
-            "mesh_shape": self._mesh_shape(),
+            "mesh_shape": self._mesh_shape(),  # gslint: disable=ckpt-symmetry (provenance: load converts cross-mesh, never needs the source shape back)
             "windows_done": self.windows_done,
             "edges_done": self.edges_done,
             "edge_bucket": self.eb,

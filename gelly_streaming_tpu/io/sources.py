@@ -98,7 +98,7 @@ def iter_edge_chunks(path: str, chunk_bytes: int = 1 << 24,
                 if stop.is_set() or not _put(arrays):
                     return  # consumer gone: stop reading the file
             _put(DONE)
-        except BaseException as e:  # surface in the consumer
+        except BaseException as e:  # surface in the consumer  # gslint: disable=except-hygiene (forwarded: consumer re-raises it)
             _put((ERROR, e))
 
     t = threading.Thread(target=produce, daemon=True)

@@ -15,6 +15,8 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from ..utils import telemetry
+
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _LIB_PATH = os.path.join(_DIR, "libgsnative.so")
 _lib: Optional[ctypes.CDLL] = None
@@ -30,7 +32,9 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             subprocess.run(["make", "-C", _DIR, "-s"], check=True,
                            capture_output=True, timeout=120)
-        except Exception:
+        except Exception as e:
+            telemetry.event("native.build_failed", durable=True,
+                            error="%s: %s" % (type(e).__name__, e))
             return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
@@ -417,5 +421,5 @@ class NativeInterner:
     def __del__(self):
         try:
             self._lib.gs_interner_free(self._handle)
-        except Exception:
+        except Exception:  # gslint: disable=except-hygiene (interpreter teardown: lib/handle may already be unloaded)
             pass

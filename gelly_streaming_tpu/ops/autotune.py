@@ -49,10 +49,10 @@ import os
 import threading
 from typing import Dict, List, Optional
 
+from ..utils import knobs
 from ..utils import telemetry
 
 _DEF_MARGIN = 1.05        # the repo-wide measured-adoption bar
-_DEF_EXPLORE_PERIOD = 3   # explore every 3rd measurement round
 _EMA_ALPHA = 0.5          # smoothing of per-arm measured rates
 _TIMELINE_CAP = 256       # bound per-tuner event history
 
@@ -60,12 +60,12 @@ _CACHE_LOCK = threading.Lock()
 
 
 # ----------------------------------------------------------------------
-# env knobs
+# env knobs (read per call through the utils/knobs registry)
 # ----------------------------------------------------------------------
 def enabled() -> bool:
     """GS_AUTOTUNE=0 disables the online tuner process-wide; callers
     then run their legacy static-gate path bit-identically."""
-    return os.environ.get("GS_AUTOTUNE", "1") != "0"
+    return knobs.get_bool("GS_AUTOTUNE")
 
 
 def round_chunks() -> int:
@@ -76,27 +76,20 @@ def round_chunks() -> int:
     silently measure (and run) the synchronous form, so the default
     keeps several chunks in flight per round; lower it only for
     diagnosis."""
-    try:
-        return max(1, int(os.environ.get("GS_AUTOTUNE_ROUND", "4")))
-    except ValueError:
-        return 4
+    return knobs.get_int("GS_AUTOTUNE_ROUND")
 
 
 def explore_period() -> int:
     """Every Nth measurement round is an exploration round
     (GS_AUTOTUNE_EXPLORE, default 3); the rest exploit the
     incumbent."""
-    try:
-        return max(2, int(os.environ.get("GS_AUTOTUNE_EXPLORE",
-                                         str(_DEF_EXPLORE_PERIOD))))
-    except ValueError:
-        return _DEF_EXPLORE_PERIOD
+    return knobs.get_int("GS_AUTOTUNE_EXPLORE")
 
 
 def cache_path(backend: str) -> str:
     """Per-backend tuning cache file. GS_TUNE_CACHE overrides the
     DIRECTORY (set it to "0" to disable persistence entirely)."""
-    root = os.environ.get("GS_TUNE_CACHE")
+    root = knobs.get_path("GS_TUNE_CACHE")
     if root == "0":
         return ""
     if not root:
@@ -110,7 +103,7 @@ def _backend() -> str:
         import jax
 
         return jax.default_backend()
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (availability probe: cache filename only, never correctness)
         return "unknown"
 
 

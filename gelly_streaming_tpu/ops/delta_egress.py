@@ -39,9 +39,10 @@ ride replicated outputs, and the mesh path has no AOT warm cache).
 
 from __future__ import annotations
 
-import os
-
 import numpy as np
+
+from ..utils import knobs
+from ..utils import telemetry
 
 _EGRESS = None   # "full" | "delta", resolved once per process
 
@@ -54,12 +55,12 @@ def _reset_egress() -> None:
 
 def resolve_egress() -> str:
     """The d2h egress format of the batched snapshot/reduce paths:
-    GS_EGRESS pins ("full"/"delta"); otherwise "delta" only on
+    GS_EGRESS pins ("full"/"delta"); unset/"auto" = "delta" only on
     committed backend-matched `egress_ab` rows all showing parity and
     a ≥5% win (the repo-wide measured-adoption policy,
     ops/triangles.rows_clear_bar). Memoized per process."""
     global _EGRESS
-    pin = os.environ.get("GS_EGRESS", "")
+    pin = knobs.get_str("GS_EGRESS")
     if pin in ("full", "delta"):
         return pin
     if _EGRESS is None:
@@ -71,8 +72,10 @@ def resolve_egress() -> str:
             if tri_ops.rows_clear_bar((perf or {}).get("egress_ab", []),
                                       "speedup", lambda r: 1.0):
                 impl = "delta"
-        except Exception:
-            pass
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="egress", fallback=impl,
+                            error="%s: %s" % (type(e).__name__, e))
         _EGRESS = impl
     return _EGRESS
 
@@ -83,12 +86,9 @@ def egress_cap(eb: int, vb: int) -> int:
     label cascades — unless GS_EGRESS_CAP narrows it (never below 1,
     never above vb)."""
     cap = min(2 * eb, vb)
-    env = os.environ.get("GS_EGRESS_CAP")
-    if env:
-        try:
-            cap = min(max(1, int(env)), vb)
-        except ValueError:
-            pass
+    pinned = knobs.get_int("GS_EGRESS_CAP")
+    if pinned is not None:
+        cap = min(pinned, vb)
     return cap
 
 

@@ -94,6 +94,7 @@ from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import Callable, Iterable, List, Optional
 
 from ..utils import faults
+from ..utils import knobs
 from ..utils import resilience
 from ..utils import telemetry
 from ..utils.resilience import StageFailed, StageTimeout
@@ -157,12 +158,9 @@ def worker_count() -> int:
     """Prep pool width: GS_PIPELINE_WORKERS, defaulting to
     min(4, cpus-1) — one core stays with the main thread's
     h2d/dispatch stage."""
-    env = os.environ.get("GS_PIPELINE_WORKERS")
+    env = knobs.get_int("GS_PIPELINE_WORKERS")
     if env is not None:
-        try:
-            return max(0, int(env))
-        except ValueError:
-            pass
+        return env
     return max(1, min(_MAX_DEFAULT_WORKERS, (os.cpu_count() or 2) - 1))
 
 
@@ -171,20 +169,14 @@ def inflight_limit() -> int:
     ahead of dispatch (GS_PIPELINE_INFLIGHT, default 3) — the bounded-
     footprint contract of the old depth-2 queue, decoupled from the
     pool width."""
-    env = os.environ.get("GS_PIPELINE_INFLIGHT")
-    if env is not None:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
-    return 3
+    return knobs.get_int("GS_PIPELINE_INFLIGHT")
 
 
 def pipeline_enabled() -> bool:
     """False when the caller (or env) pinned the synchronous form."""
     if _FORCE_SYNC:
         return False
-    if os.environ.get("GS_STREAM_PREFETCH", "1") == "0":
+    if not knobs.get_bool("GS_STREAM_PREFETCH"):
         return False
     return worker_count() > 0
 
@@ -348,7 +340,7 @@ def _await_attempt(wait_tick: Callable, outcome: Callable,
         if wait_tick(_POLL_S if timeout > 0 else None):
             try:
                 return True, outcome(), None
-            except BaseException as e:
+            except BaseException as e:  # gslint: disable=except-hygiene (captured: caller raises or retries it)
                 return False, e, cell.get("stage")
         stage = cell.get("stage", "queued")
         since = cell.get("since", queued_since)
@@ -398,7 +390,7 @@ def _guarded_prep_h2d(prep: Callable, h2d: Callable, item,
                 try:
                     box["value"] = _prep_then_h2d(prep, h2d, item,
                                                   timers, cell)
-                except BaseException as e:
+                except BaseException as e:  # gslint: disable=except-hygiene (captured: _outcome re-raises on the waiter)
                     box["error"] = e
                 finally:
                     done.set()
@@ -417,7 +409,7 @@ def _guarded_prep_h2d(prep: Callable, h2d: Callable, item,
             cell = {"tctx": (first_cell or {}).get("tctx")}
             try:
                 return _prep_then_h2d(prep, h2d, item, timers, cell)
-            except Exception as e:
+            except Exception as e:  # gslint: disable=except-hygiene (captured: the retry loop re-raises as StageFailed)
                 ok, res, stage = False, e, cell.get("stage")
         if ok:
             return res
@@ -452,14 +444,14 @@ def _future_wait(fut, t: Optional[float]) -> bool:
     if t is None:
         try:
             fut.exception()  # blocks to completion; outcome re-raises
-        except BaseException:
+        except BaseException:  # gslint: disable=except-hygiene (wait only: outcome() re-raises the real error)
             pass
         return True
     try:
         fut.exception(timeout=t)
     except _FutureTimeout:
         return fut.done()
-    except BaseException:
+    except BaseException:  # gslint: disable=except-hygiene (wait only: outcome() re-raises the real error)
         pass
     return True
 
@@ -605,8 +597,15 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
             done_chunk, pending = pending, None
             try:
                 _finalize(*done_chunk)
-            except Exception:
-                pass
+            except Exception as drain_err:
+                try:
+                    telemetry.event(
+                        "drain_failed", durable=True,
+                        component="ingress_pipeline",
+                        error="%s: %s" % (type(drain_err).__name__,
+                                          drain_err))
+                except Exception:  # gslint: disable=except-hygiene (a failing ledger write must not replace the typed StageError the demotion ladder keys on)
+                    pass
         raise
     finally:
         for _it, _cell, f in futures:

@@ -186,7 +186,7 @@ def _load_matching_perf(required_backend: str = None):
             try:
                 with open(path) as f:
                     cand = json.load(f)
-            except Exception:
+            except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
                 continue
             if cand.get("backend") == backend:
                 perf = cand
@@ -198,7 +198,7 @@ def _load_matching_perf(required_backend: str = None):
         # measurement rows
         return {k: v for k, v in perf.items()
                 if not (isinstance(v, dict) and "error" in v)}
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         return None
 
 
@@ -268,7 +268,7 @@ def resolve_xla_intersect():
 
         if _jax.default_backend() == "cpu":
             return intersect_local_bsearch
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         pass
     return intersect_local
 
@@ -424,7 +424,7 @@ def build_window_counter(vb: int, kb: int):
 # ----------------------------------------------------------------------
 
 _STREAM_IMPL = None    # cpu-backend tier, resolved once per process
-_STREAM_IMPL_EB = {}   # chip per-bucket tier (eb -> impl)
+_STREAM_IMPL_EB = {}   # chip per-bucket tier (eb -> impl)  # gslint: disable=thread-shared (idempotent memo: same key always computes the same value; a racing double-compute is last-write-wins)
 
 
 def _pick_host_tier(rows) -> str:
@@ -514,7 +514,7 @@ def _resolve_stream_impl(eb: int = None) -> str:
         import jax as _jax
 
         backend = _jax.default_backend()
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         return "device"
     if backend == "cpu":
         if _STREAM_IMPL is not None:
@@ -523,7 +523,7 @@ def _resolve_stream_impl(eb: int = None) -> str:
         try:
             perf = _load_matching_perf("cpu")
             impl = _pick_host_tier((perf or {}).get("host_stream", []))
-        except Exception:
+        except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
             pass
         _STREAM_IMPL = impl
         return impl
@@ -538,7 +538,7 @@ def _resolve_stream_impl(eb: int = None) -> str:
                 if r.get("edge_bucket") == eb]
         if rows:
             impl = _pick_host_tier(rows)
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         pass
     _STREAM_IMPL_EB[eb] = impl
     return impl
@@ -572,7 +572,7 @@ def resolve_ingress(vb: int) -> str:
             if rows_clear_bar((perf or {}).get("ingress_ab", []),
                               "speedup", lambda r: 1.0):
                 impl = "compact"
-        except Exception:
+        except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
             pass
         _INGRESS = impl
     if _INGRESS == "compact":
@@ -583,7 +583,7 @@ def resolve_ingress(vb: int) -> str:
     return _INGRESS
 
 
-_TUNED_KB = {}  # eb -> measured starting K (resolved once per process)
+_TUNED_KB = {}  # eb -> measured starting K (resolved once per process)  # gslint: disable=thread-shared (idempotent memo of committed PERF.json evidence)
 
 
 def _tuned_kb(eb: int) -> int:
@@ -638,10 +638,10 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
                 key=lambda s: s["per_window_ms"])[value_key]))
     return default
 
-_TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch
+_TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch  # gslint: disable=thread-shared (idempotent memo of committed PERF.json evidence)
 
 
-_COMPILE_CAPS = {}           # program -> slots, resolved once per process
+_COMPILE_CAPS = {}           # program -> slots, resolved once per process  # gslint: disable=thread-shared (idempotent memo: probe result is deterministic per program)
 _COMPILE_CAP_DEFAULT = 1 << 19
 # sizes proven clean OUTSIDE the probe (the round-4 chip window's
 # bench compiles): a probed failure above these never lowers the cap
@@ -699,7 +699,7 @@ def compile_cap(program: str = "triangle_stream") -> int:
             if proven is not None and proven < failed[0]:
                 floor.append(proven)
             cap = max(floor) if floor else max(1, failed[0] // 4)
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         pass
     _COMPILE_CAPS[program] = cap
     return cap
@@ -715,7 +715,7 @@ def capped_chunk(eb: int, program: str) -> int:
 
         if _jax.default_backend() == "tpu":
             return max(1, compile_cap(program) // max(eb, 1))
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         pass
     return TriangleWindowKernel.MAX_STREAM_WINDOWS
 
@@ -755,7 +755,7 @@ def _tuned_chunk(eb: int) -> int:
         if _jax.default_backend() == "tpu":
             val = min(val, max(1, compile_cap("triangle_stream")
                                // max(eb, 1)))
-    except Exception:
+    except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         pass
     _TUNED_CHUNK[eb] = val
     return _TUNED_CHUNK[eb]

@@ -32,11 +32,12 @@ from typing import List, Tuple
 import numpy as np
 
 from . import segment as seg_ops
+from ..utils import telemetry
 
 _DIRECTIONS = ("out", "in", "all")
 
 
-_REDUCE_IMPL = {}   # name -> "device" | "host", resolved once per process
+_REDUCE_IMPL = {}   # name -> "device" | "host", resolved once per process  # gslint: disable=thread-shared (idempotent memo of committed PERF.json evidence)
 
 
 def _resolve_reduce_impl(name: str, allow_native: bool = True) -> str:
@@ -86,8 +87,10 @@ def _resolve_reduce_impl(name: str, allow_native: bool = True) -> str:
 
                 if _native.windowed_reduce_available():
                     impl = "native"
-    except Exception:
-        pass
+    except Exception as e:
+        telemetry.event("selection.fallback", durable=True,
+                        component="windowed_reduce", fallback=impl,
+                        error="%s: %s" % (type(e).__name__, e))
     _REDUCE_IMPL[key] = impl
     return impl
 

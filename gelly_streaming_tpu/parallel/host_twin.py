@@ -117,7 +117,7 @@ class HostWindowEngine:
     def state_dict(self) -> dict:
         state = {
             "vb": self.vb,
-            "mesh_shape": None,  # the twin IS the no-mesh floor
+            "mesh_shape": None,  # the twin IS the no-mesh floor  # gslint: disable=ckpt-symmetry (provenance only; load ignores it)
             "degree_state": self._degree_state.copy(),
             "labels": self._labels.copy(),
         }

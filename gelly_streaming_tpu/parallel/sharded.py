@@ -409,7 +409,11 @@ def _resolve_table_mode_uncached() -> str:
             import jax as _jax
 
             live = _jax.default_backend()
-        except Exception:
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="sharded_table",
+                            fallback="replicated",
+                            error="%s: %s" % (type(e).__name__, e))
             return "replicated"
         row_backend = row.get("backend")
         if row_backend not in (live, "%s-virtual-mesh" % live):
@@ -1001,7 +1005,7 @@ class ShardedWindowEngine:
         fire_shard_gather(self.n)
         state = {
             "vb": self.vb,
-            "mesh_shape": [self.n],
+            "mesh_shape": [self.n],  # gslint: disable=ckpt-symmetry (provenance only — load adopts any mesh width)
             "degree_state": np.asarray(self._degree_state),
             "labels": np.asarray(self._labels),
         }

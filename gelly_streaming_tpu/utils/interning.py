@@ -13,6 +13,8 @@ from typing import Dict, Hashable, List
 
 import numpy as np
 
+from . import telemetry
+
 
 class IncrementalInterner:
     def __init__(self):
@@ -122,6 +124,8 @@ def make_interner(ids_sample: np.ndarray = None):
 
             if native.available():
                 return native.NativeInterner()
-        except Exception:
-            pass
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="interner", fallback="python",
+                            error="%s: %s" % (type(e).__name__, e))
     return IncrementalInterner()

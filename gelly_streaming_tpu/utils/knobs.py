@@ -1,0 +1,305 @@
+"""The typed `GS_*` knob registry — the ONE place environment knobs
+are declared, parsed, and documented.
+
+Before this module, 33 `GS_*` knobs were read at 23 scattered
+`os.environ` sites, each reimplementing the same parse-clamp-default
+helper (utils/resilience, utils/telemetry, ops/autotune,
+ops/delta_egress, ops/ingress_pipeline all had private copies), and
+the README knob table was maintained by hand — so a renamed knob, a
+changed default, or a typo'd value degraded silently. Here every knob
+is a `Knob` entry with a kind, a default, clamp bounds, and the
+one-line meaning the README table renders, and every read goes
+through `get()`:
+
+- Reads are LIVE (`os.environ` consulted per call, never cached):
+  tests and tools/chaos_run.py flip knobs mid-process, and the old
+  helpers were deliberately per-call for exactly that reason.
+- A malformed value raises typed `KnobError` naming the knob, the
+  offending text, and the expected kind — failing fast at the read
+  site instead of silently running with a default the operator did
+  not ask for (the old helpers swallowed `ValueError` into the
+  default, which is how a mistyped `GS_STAGE_TIMEOUT_S=3O` disarms
+  the watchdog unnoticed).
+- `tools/gslint` rule R3 enforces adoption: any `os.environ` read
+  inside `gelly_streaming_tpu/` outside this module (and the
+  non-knob backend setup in core/platform.py) is a lint finding, and
+  the README table is diffed row-for-row against `render_table()` so
+  the docs cannot drift from the code.
+
+Unset and empty both mean "default": an empty string is what
+`VAR= python ...` and CI templating produce for "not configured",
+and no knob here distinguishes empty from absent on purpose.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+__all__ = [
+    "Knob", "KnobError", "REGISTRY", "register",
+    "get_int", "get_float", "get_bool", "get_str", "get_path",
+    "render_table",
+]
+
+_TRUE = ("1", "true", "yes", "on")
+_FALSE = ("0", "false", "no", "off")
+
+
+class KnobError(ValueError):
+    """A `GS_*` environment value could not be parsed as its declared
+    kind. Carries `.knob` (the Knob) and `.value` (the offending
+    text) so a harness can report exactly what to fix."""
+
+    def __init__(self, knob: "Knob", value: str, problem: str):
+        super().__init__(
+            "%s=%r: %s (expected %s; default %r)"
+            % (knob.name, value, problem, knob.kind, knob.default))
+        self.knob = knob
+        self.value = value
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One declared environment knob. `kind` is one of
+    'int' / 'float' / 'bool' / 'str' / 'path'; `lo`/`hi` clamp parsed
+    numbers (clamping, not raising: the bounds encode "16 is the
+    smallest useful ring", not user error); `choices` restricts str
+    knobs; `default_text` overrides how the default renders in the
+    README table (e.g. "min(2·eb, vb)" for a computed default);
+    `help` is the table's meaning column."""
+
+    name: str
+    kind: str
+    default: object
+    help: str
+    lo: Optional[float] = None
+    hi: Optional[float] = None
+    choices: Optional[Tuple[str, ...]] = None
+    default_text: Optional[str] = None
+
+
+REGISTRY: Dict[str, Knob] = {}
+
+
+def register(name: str, kind: str, default, help: str, **kw) -> Knob:
+    assert name.startswith("GS_"), name
+    assert kind in ("int", "float", "bool", "str", "path"), kind
+    assert name not in REGISTRY, "duplicate knob %s" % name
+    knob = Knob(name, kind, default, help, **kw)
+    REGISTRY[name] = knob
+    return knob
+
+
+def _raw(name: str) -> Optional[str]:
+    """The live environment text, with unset and empty unified to
+    None (= use the default)."""
+    val = os.environ.get(name)
+    return None if val is None or val == "" else val
+
+
+def _clamp(knob: Knob, num):
+    if knob.lo is not None and num < knob.lo:
+        num = type(num)(knob.lo)
+    if knob.hi is not None and num > knob.hi:
+        num = type(num)(knob.hi)
+    return num
+
+
+def _knob(name: str, kind: str) -> Knob:
+    knob = REGISTRY.get(name)
+    assert knob is not None, "unregistered knob %s" % name
+    assert knob.kind == kind, (name, knob.kind, kind)
+    return knob
+
+
+def get_int(name: str) -> Optional[int]:
+    knob = _knob(name, "int")
+    raw = _raw(name)
+    if raw is None:
+        return knob.default if knob.default is None \
+            else _clamp(knob, int(knob.default))
+    try:
+        num = int(raw)
+    except ValueError:
+        raise KnobError(knob, raw, "not an integer") from None
+    return _clamp(knob, num)
+
+
+def get_float(name: str) -> Optional[float]:
+    knob = _knob(name, "float")
+    raw = _raw(name)
+    if raw is None:
+        return knob.default if knob.default is None \
+            else _clamp(knob, float(knob.default))
+    try:
+        num = float(raw)
+    except ValueError:
+        raise KnobError(knob, raw, "not a number") from None
+    return _clamp(knob, num)
+
+
+def get_bool(name: str) -> bool:
+    knob = _knob(name, "bool")
+    raw = _raw(name)
+    if raw is None:
+        return bool(knob.default)
+    low = raw.lower()
+    if low in _TRUE:
+        return True
+    if low in _FALSE:
+        return False
+    raise KnobError(knob, raw, "not a boolean (%s / %s)"
+                    % ("/".join(_TRUE), "/".join(_FALSE)))
+
+
+def get_str(name: str) -> str:
+    knob = _knob(name, "str")
+    raw = _raw(name)
+    if raw is None:
+        return knob.default
+    if knob.choices is not None and raw not in knob.choices:
+        raise KnobError(knob, raw,
+                        "not one of %s" % "/".join(knob.choices))
+    return raw
+
+
+def get_path(name: str) -> Optional[str]:
+    """Path knobs: a filesystem location (or the conventional "0" =
+    explicitly disabled, which callers test for). None = unset."""
+    knob = _knob(name, "path")
+    raw = _raw(name)
+    return knob.default if raw is None else raw
+
+
+# ----------------------------------------------------------------------
+# the registry — grouped as the README table renders them
+# ----------------------------------------------------------------------
+
+# ingress pipeline (ops/ingress_pipeline.py)
+register("GS_PIPELINE_WORKERS", "int", None, lo=0,
+         help="prep worker-pool width; unset = min(4, cpus-1), `0` "
+              "pins the synchronous single-thread form",
+         default_text="min(4, cpus-1)")
+register("GS_PIPELINE_INFLIGHT", "int", 3, lo=1,
+         help="max prepped+transferred chunks kept in flight ahead of "
+              "dispatch (the bounded-footprint contract)")
+register("GS_STREAM_PREFETCH", "bool", True,
+         help="`0` pins the synchronous ingress form everywhere (the "
+              "A/B lever `ops/ingress_pipeline.forced_sync` scopes "
+              "per-measurement)")
+
+# stage watchdogs & tier demotion (utils/resilience.py)
+register("GS_STAGE_TIMEOUT_S", "float", 0.0, lo=0.0,
+         help="per-stage watchdog deadline: a hung "
+              "prep/h2d/dispatch/finalize surfaces as a typed "
+              "`StageTimeout` naming the chunk instead of stalling "
+              "forever; 0 = off",
+         default_text="0 (off)")
+register("GS_STAGE_RETRIES", "int", 0, lo=0,
+         help="bounded retry for the pure stages (prep, h2d, the "
+              "driver's scan dispatch); exhaustion raises "
+              "`StageFailed` with per-attempt timings")
+register("GS_STAGE_BACKOFF_S", "float", 0.05, lo=0.0,
+         help="deterministic (jitterless) exponential backoff base "
+              "between attempts")
+register("GS_TIER_RETRY_WINDOWS", "int", 0, lo=0,
+         help="probation length before a demoted snapshot tier "
+              "re-probes the faster one; 0 = never",
+         default_text="0 (never)")
+register("GS_TIER_DEMOTE", "bool", True,
+         help="`0` pins the resolved tier: persistent device failure "
+              "raises instead of degrading sharded→scan→native→host")
+register("GS_MESH_DEMOTE", "bool", True,
+         help="`0` pins a sharded session to the mesh (the "
+              "`sharded→scan` rung specifically): a dead shard then "
+              "raises the typed stage error instead of degrading to "
+              "one device; subordinate to `GS_TIER_DEMOTE`")
+register("GS_MESH_WIRE_CHECK", "bool", False,
+         help="`1` arms the per-shard range check of every mesh-bound "
+              "h2d stack (`parallel/sharded.guard_wire`): a corrupt "
+              "shard wire surfaces as a typed stage failure naming "
+              "the shard instead of scattering garbage ids into "
+              "carried state",
+         default_text="0 (off)")
+
+# dispatch autotuner (ops/autotune.py)
+register("GS_AUTOTUNE", "bool", True,
+         help="`0` disables the online dispatch scheduler "
+              "(`ops/autotune.py`): windows-per-dispatch / K / "
+              "ingress then run today's static committed-evidence "
+              "gates bit-identically; on, the tuner ε-greedily "
+              "(deterministically, with 1.05× hysteresis) finds the "
+              "fast configuration on the live stream")
+register("GS_AUTOTUNE_ROUND", "int", 4, lo=1,
+         help="dispatch chunks per tuner measurement round; a 1-chunk "
+              "round would silently measure the synchronous form")
+register("GS_AUTOTUNE_EXPLORE", "int", 3, lo=2,
+         help="every Nth measurement round explores the next "
+              "single-knob move off the incumbent; the rest exploit")
+register("GS_TUNE_CACHE", "path", None,
+         help="directory of the per-backend tuning cache "
+              "(`tuning_<backend>.json`) that seeds the next run with "
+              "this run's optimum; `0` disables persistence",
+         default_text="`~/.cache/gelly_streaming_tpu`")
+
+# egress (ops/delta_egress.py)
+register("GS_EGRESS", "str", "", choices=("full", "delta", "auto"),
+         help="pin the batched d2h egress: `full` (whole snapshot "
+              "vectors) or `delta` (per-window changed-slot wire, "
+              "`ops/delta_egress.py`); unset/`auto` = adopt delta "
+              "only on committed parity+≥5% `egress_ab` rows",
+         default_text="auto")
+register("GS_EGRESS_CAP", "int", None, lo=1,
+         help="per-window changed-slot capacity of the delta wire; a "
+              "window that overflows it refolds its chunk on the "
+              "bit-exact host twin, so any cap stays exact",
+         default_text="min(2·eb, vb)")
+
+# flight recorder (utils/telemetry.py)
+register("GS_TELEMETRY", "bool", False,
+         help="arm the flight recorder (`utils/telemetry.py`): "
+              "unified spans/counters/gauges with per-run trace IDs "
+              "and per-chunk correlation across every layer; off, "
+              "every hook is a guarded no-op and the hot path is "
+              "bit-identical (bench A/B sections run disarmed by "
+              "default)",
+         default_text="0 (off)")
+register("GS_TRACE_DIR", "path", None,
+         help="directory of the crash-safe JSONL run ledger "
+              "(`trace_<id>.jsonl`); durable-class events (kills, "
+              "demotions, stage timeouts, checkpoints, resumes) are "
+              "appended+fsync'd immediately, buffered spans flush at "
+              "exit/SIGTERM/fatal-fault",
+         default_text="unset")
+register("GS_TRACE_RING", "int", 4096, lo=16,
+         help="in-memory ring-buffer capacity (records) — the "
+              "\"last N spans\" a wedge still leaves on disk")
+register("GS_TRACE_DURABLE", "bool", True,
+         help="`0` drops the per-durable-event fsync (append still "
+              "happens; only the power-loss window widens)")
+
+
+# ----------------------------------------------------------------------
+# docs rendering (README table; gslint R3 diffs it back)
+# ----------------------------------------------------------------------
+def _default_cell(knob: Knob) -> str:
+    if knob.default_text is not None:
+        return knob.default_text
+    if knob.kind == "bool":
+        return "1" if knob.default else "0"
+    return str(knob.default)
+
+
+def render_table() -> str:
+    """The README `GS_*` knob table, one row per registered knob in
+    registration order. tests/test_knobs.py (and gslint R3's docs
+    check) assert the committed README contains exactly this block —
+    regenerate with `python -m tools.gslint --knob-table`."""
+    lines = ["| knob | default | meaning |", "|---|---|---|"]
+    for knob in REGISTRY.values():
+        lines.append("| `%s` | %s | %s |"
+                     % (knob.name, _default_cell(knob),
+                        " ".join(knob.help.split())))
+    return "\n".join(lines)

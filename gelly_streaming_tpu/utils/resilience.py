@@ -34,12 +34,12 @@ with `retries=0` — deadline only — by their callers.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, List, Optional
 
 from . import faults
+from . import knobs
 from . import telemetry
 
 
@@ -81,25 +81,19 @@ class StageFailed(StageError):
 
 
 # ----------------------------------------------------------------------
-# env knobs (read per call: tests and tools/chaos_run.py flip them
-# mid-process; parsing two ints per guarded chunk is noise)
+# env knobs (read per call through the utils/knobs registry: tests and
+# tools/chaos_run.py flip them mid-process)
 # ----------------------------------------------------------------------
 def stage_timeout_s() -> float:
     """Per-stage watchdog deadline in seconds (GS_STAGE_TIMEOUT_S);
     0 (default) disables the watchdog entirely."""
-    try:
-        return max(0.0, float(os.environ.get("GS_STAGE_TIMEOUT_S", "0")))
-    except ValueError:
-        return 0.0
+    return knobs.get_float("GS_STAGE_TIMEOUT_S")
 
 
 def stage_retries() -> int:
     """Extra attempts after the first failure/timeout
     (GS_STAGE_RETRIES, default 0 = fail on first error)."""
-    try:
-        return max(0, int(os.environ.get("GS_STAGE_RETRIES", "0")))
-    except ValueError:
-        return 0
+    return knobs.get_int("GS_STAGE_RETRIES")
 
 
 def stage_backoff_s() -> float:
@@ -108,11 +102,7 @@ def stage_backoff_s() -> float:
     default 0.05). Jitter exists to de-correlate fleets; a single
     streaming process gains nothing from it and loses reproducibility.
     """
-    try:
-        return max(0.0, float(os.environ.get("GS_STAGE_BACKOFF_S",
-                                             "0.05")))
-    except ValueError:
-        return 0.05
+    return knobs.get_float("GS_STAGE_BACKOFF_S")
 
 
 def guard_active() -> bool:
@@ -135,7 +125,7 @@ def _run_with_deadline(fn: Callable, timeout: float):
     def runner():
         try:
             box["value"] = fn()
-        except BaseException as e:
+        except BaseException as e:  # gslint: disable=except-hygiene (captured: _run_with_deadline re-raises on the caller)
             box["error"] = e
         finally:
             done.set()
@@ -269,10 +259,7 @@ def tier_retry_windows() -> int:
     demoted tier without failure, the driver retries the higher tier
     once; a repeat failure demotes again (and restarts probation).
     0 (default) = a demotion is permanent for the process."""
-    try:
-        return max(0, int(os.environ.get("GS_TIER_RETRY_WINDOWS", "0")))
-    except ValueError:
-        return 0
+    return knobs.get_int("GS_TIER_RETRY_WINDOWS")
 
 
 def tier_demotion_enabled() -> bool:
@@ -280,7 +267,7 @@ def tier_demotion_enabled() -> bool:
     of degrading — what a measurement harness wants (a silently
     demoted bench row is worse than a failed one; the profiler also
     labels any demotion that does happen)."""
-    return os.environ.get("GS_TIER_DEMOTE", "1") != "0"
+    return knobs.get_bool("GS_TIER_DEMOTE")
 
 
 def mesh_demotion_enabled() -> bool:
@@ -290,7 +277,7 @@ def mesh_demotion_enabled() -> bool:
     GS_TIER_DEMOTE, which pins EVERY rung). Default 1: a dead shard
     degrades the stream to one device instead of wedging it — the
     multi-chip leg of the core/driver demotion ladder."""
-    return os.environ.get("GS_MESH_DEMOTE", "1") != "0"
+    return knobs.get_bool("GS_MESH_DEMOTE")
 
 
 def mesh_wire_check_enabled() -> bool:
@@ -301,4 +288,4 @@ def mesh_wire_check_enabled() -> bool:
     a typed stage failure naming the shard instead of scattering
     out-of-range ids into carried state. Default 0: the hot path
     stays byte-identical to the unguarded form."""
-    return os.environ.get("GS_MESH_WIRE_CHECK", "0") == "1"
+    return knobs.get_bool("GS_MESH_WIRE_CHECK")

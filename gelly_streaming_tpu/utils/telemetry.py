@@ -56,39 +56,36 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-_DEF_RING = 4096
+from . import knobs
+
 _SAMPLE_CAP = 2048  # per-span-name duration reservoir for summary()
 
 clock = time.perf_counter  # the one monotonic clock every record uses
 
 
 # ----------------------------------------------------------------------
-# env knobs (read per call, like utils/resilience: tests and tools flip
-# them mid-process)
+# env knobs (read per call through the utils/knobs registry: tests and
+# tools flip them mid-process)
 # ----------------------------------------------------------------------
 def enabled() -> bool:
     """GS_TELEMETRY arms the recorder; off (the default) every hook is
     a guarded no-op and span() is a bare stopwatch."""
-    return os.environ.get("GS_TELEMETRY", "0") not in ("0", "")
+    return knobs.get_bool("GS_TELEMETRY")
 
 
 def trace_dir() -> Optional[str]:
     """Ledger directory (GS_TRACE_DIR); None = ring only."""
-    return os.environ.get("GS_TRACE_DIR") or None
+    return knobs.get_path("GS_TRACE_DIR")
 
 
 def ring_size() -> int:
-    try:
-        return max(16, int(os.environ.get("GS_TRACE_RING",
-                                          str(_DEF_RING))))
-    except ValueError:
-        return _DEF_RING
+    return knobs.get_int("GS_TRACE_RING")
 
 
 def durable_sync() -> bool:
     """GS_TRACE_DURABLE=0 drops the per-durable-event fsync (append
     still happens; only the power-loss window widens)."""
-    return os.environ.get("GS_TRACE_DURABLE", "1") != "0"
+    return knobs.get_bool("GS_TRACE_DURABLE")
 
 
 # ----------------------------------------------------------------------
@@ -112,6 +109,7 @@ class _Recorder:
         self.gauges: Dict[str, float] = {}
         self.ledger = None        # open file object, lazily created
         self.ledger_path = None
+        self.ledger_failed = False  # sticky: disk broke, stop trying
 
     # -- ledger --------------------------------------------------------
     def _ensure_ledger(self):
@@ -120,34 +118,55 @@ class _Recorder:
         monotonic span timestamps back to wall time."""
         if self.ledger is not None:
             return self.ledger
+        if self.ledger_failed:
+            return None
         d = trace_dir()
         if d is None:
             return None
-        os.makedirs(d, exist_ok=True)
-        self.ledger_path = os.path.join(d,
-                                        "trace_%s.jsonl" % self.trace)
-        self.ledger = open(self.ledger_path, "a")
-        self.ledger.write(json.dumps({
-            "t": "meta", "trace": self.trace, "pid": os.getpid(),
-            "epoch": self.epoch, "mono": self.mono,
-            "ring": self.ring.maxlen}) + "\n")
-        self.ledger.flush()
+        # an unwritable/full trace dir degrades to ring-only recording:
+        # the flight recorder must never take down the stream it traces
+        try:
+            os.makedirs(d, exist_ok=True)
+            self.ledger_path = os.path.join(
+                d, "trace_%s.jsonl" % self.trace)
+            self.ledger = open(self.ledger_path, "a")
+            self.ledger.write(json.dumps({
+                "t": "meta", "trace": self.trace, "pid": os.getpid(),
+                "epoch": self.epoch, "mono": self.mono,
+                "ring": self.ring.maxlen}) + "\n")
+            self.ledger.flush()
+        except OSError:
+            self._ledger_broke()
+            return None
         _install_exit_hooks()
         return self.ledger
+
+    def _ledger_broke(self) -> None:
+        self.ledger_failed = True
+        self.ledger_path = None
+        if self.ledger is not None:
+            try:
+                self.ledger.close()
+            except OSError:
+                pass
+            self.ledger = None
 
     def _append(self, rec: dict, sync: bool) -> None:
         f = self._ensure_ledger()
         if f is None:
             return
-        f.write(json.dumps(rec, default=str) + "\n")
-        rec["_w"] = True  # private written mark, stripped on flush
-        if sync:
-            f.flush()
-            if durable_sync():
-                try:
-                    os.fsync(f.fileno())
-                except OSError:
-                    pass
+        try:
+            f.write(json.dumps(rec, default=str) + "\n")
+            rec["_w"] = True  # private written mark, stripped on flush
+            if sync:
+                f.flush()
+                if durable_sync():
+                    try:
+                        os.fsync(f.fileno())
+                    except OSError:
+                        pass
+        except OSError:
+            self._ledger_broke()
 
     def flush(self) -> None:
         """Drain every not-yet-written ring record to the ledger (the
@@ -156,17 +175,20 @@ class _Recorder:
             f = self._ensure_ledger()
             if f is None:
                 return
-            for rec in self.ring:
-                if not rec.get("_w"):
-                    f.write(json.dumps(
-                        {k: v for k, v in rec.items() if k != "_w"},
-                        default=str) + "\n")
-                    rec["_w"] = True
-            f.flush()
             try:
-                os.fsync(f.fileno())
+                for rec in self.ring:
+                    if not rec.get("_w"):
+                        f.write(json.dumps(
+                            {k: v for k, v in rec.items() if k != "_w"},
+                            default=str) + "\n")
+                        rec["_w"] = True
+                f.flush()
+                try:
+                    os.fsync(f.fileno())
+                except OSError:
+                    pass
             except OSError:
-                pass
+                self._ledger_broke()
 
     # -- recording -----------------------------------------------------
     def add(self, rec: dict, durable: bool = False) -> None:

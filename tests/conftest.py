@@ -27,6 +27,11 @@ def pytest_configure(config):
         "(utils/faults) — CPU-only, no randomness, real sleeps bounded "
         "by ~100ms-scale watchdog deadlines; runs in tier-1 (it is "
         "deliberately NOT 'slow')")
+    config.addinivalue_line(
+        "markers", "lint: static project-invariant suite "
+        "(tools/gslint + utils/knobs) — pure AST/source checks, no "
+        "device, no randomness; runs in tier-1 so an invariant "
+        "violation is a test failure")
 
 
 @pytest.fixture

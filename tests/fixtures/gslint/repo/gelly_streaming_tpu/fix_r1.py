@@ -1,0 +1,20 @@
+"""R1 fixture: a jax-importing module with unsanctioned sync calls
+(true positives) plus pragma'd and non-sync forms (true negatives)."""
+
+import jax
+import numpy as np
+
+
+def bad_syncs(dev, w):
+    a = np.asarray(dev)                       # TP: d2h materialize
+    b = jax.device_get(dev)                   # TP
+    c = dev.item()                            # TP: forced scalar
+    dev.block_until_ready()                   # TP
+    d = float(dev[w])                         # TP: forced device scalar
+    return a, b, c, d
+
+
+def fine(dev, n):
+    ok = np.asarray(dev)  # gslint: disable=host-sync (sanctioned by review: test fixture)
+    e = float(n)          # TN: plain name, everyday host arithmetic
+    return ok, e

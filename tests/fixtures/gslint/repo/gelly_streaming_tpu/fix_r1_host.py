@@ -1,0 +1,8 @@
+"""R1 true-negative fixture: no jax import — np.asarray here is
+numpy-on-numpy, never a device sync."""
+
+import numpy as np
+
+
+def pure_host(rows):
+    return np.asarray(rows, np.int64).sum()

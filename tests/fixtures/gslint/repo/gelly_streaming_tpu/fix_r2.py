@@ -1,0 +1,31 @@
+"""R2 fixture: impure reads reachable from traced roots (true
+positives) vs the same reads in untraced host code (true negatives)."""
+
+import os
+import time
+
+import jax
+from jax import lax
+
+from .utils import knobs
+
+_MEMO = {}
+
+
+def _step(carry, x):
+    flag = os.environ.get("GS_TELEMETRY")      # TP: frozen at trace
+    t = time.perf_counter()                    # TP: trace-time clock
+    k = knobs.get_bool("GS_AUTOTUNE")          # TP: frozen knob read
+    return carry + x + len(_MEMO) + k, (flag, t)  # TP: module mutable
+
+
+@jax.jit
+def traced(xs):
+    return lax.scan(_step, 0, xs)
+
+
+def host_only():
+    # TN: same reads, never traced
+    _MEMO["x"] = os.environ.get("GS_TELEMETRY")
+    _MEMO["k"] = knobs.get_bool("GS_AUTOTUNE")
+    return time.perf_counter()

@@ -1,0 +1,8 @@
+"""R3 fixture: raw env reads and an unregistered knob literal (true
+positives) vs a registered knob name (true negative)."""
+
+import os
+
+RAW = os.environ.get("GS_TELEMETRY")     # TP: read outside knobs.py
+TYPO = "GS_TELEMETRYY"                   # TP: unregistered GS_* name
+OK = "GS_TELEMETRY"                      # TN: registered knob name

@@ -1,0 +1,34 @@
+"""R4 fixture: silent broad excepts (true positives) vs re-raising /
+recording / pragma'd handlers (true negatives)."""
+
+from .utils import telemetry  # noqa: F401 (parsed, never imported)
+
+
+def swallows():
+    try:
+        risky()
+    except Exception:        # TP: silent swallow
+        pass
+    try:
+        risky()
+    except:                  # TP: bare except  # noqa: E722
+        return None
+
+
+def compliant():
+    try:
+        risky()
+    except Exception as e:   # TN: raises typed
+        raise RuntimeError("wrapped") from e
+    try:
+        risky()
+    except Exception as e:   # TN: records a flight-recorder event
+        telemetry.event("probe_failed", error=str(e))
+    try:
+        risky()
+    except Exception:  # gslint: disable=except-hygiene (benign probe)
+        pass
+
+
+def risky():
+    raise ValueError

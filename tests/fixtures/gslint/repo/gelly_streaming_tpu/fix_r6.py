@@ -1,0 +1,31 @@
+"""R6 fixture: asymmetric checkpoint keys (true positives) vs a
+symmetric pair and a pragma'd provenance key (true negatives)."""
+
+
+class Asymmetric:
+    def state_dict(self):
+        return {"kept": self.kept, "orphan_saved": 1}  # orphan: TP
+
+    def load_state_dict(self, state):
+        self.kept = state["kept"]
+        self.ghost = state.get("orphan_loaded")        # ghost: TP
+
+
+class Symmetric:
+    def state_dict(self):
+        return {"a": self.a, "b": self.b}
+
+    def load_state_dict(self, state):
+        self.a = state["a"]
+        self.b = state.get("b", 0)
+
+
+class Provenance:
+    def state_dict(self):
+        return {
+            "a": self.a,
+            "mesh_shape": None,  # gslint: disable=ckpt-symmetry (provenance only)
+        }
+
+    def load_state_dict(self, state):
+        self.a = state["a"]

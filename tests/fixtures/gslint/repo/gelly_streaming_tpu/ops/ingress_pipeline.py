@@ -1,0 +1,17 @@
+"""R5 fixture (at a threaded-module path): an unguarded mutated
+module mutable (true positive) vs lock-guarded and read-only ones
+(true negatives)."""
+
+import threading
+
+_UNGUARDED = {}              # TP: mutated below, never under a lock
+_GUARDED = {}                # TN: accessed under _LOCK
+_TABLE = {"a": 1}            # TN: read-only after import
+_LOCK = threading.Lock()
+
+
+def touch(key, value):
+    _UNGUARDED[key] = value
+    with _LOCK:
+        _GUARDED[key] = value
+    return _TABLE["a"]

@@ -138,6 +138,27 @@ def test_context_binds_correlation_attrs(armed):
 # ----------------------------------------------------------------------
 # ring bounds
 # ----------------------------------------------------------------------
+def test_unwritable_trace_dir_degrades_to_ring(monkeypatch):
+    # a broken ledger disk must never take down the stream it traces:
+    # recording degrades to ring-only, durable events + flush are no-ops
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", "/proc/no_such_dir/traces")
+    telemetry.reset()
+    try:
+        telemetry.event("selection.fallback", durable=True,
+                        component="test", fallback="x")
+        with telemetry.span("s"):
+            pass
+        telemetry.flush()
+        assert telemetry.ledger_path() is None
+        # the ring still saw everything
+        assert [r["name"] for r in telemetry.records()] == [
+            "selection.fallback", "s"]
+    finally:
+        telemetry.reset()
+
+
+# ----------------------------------------------------------------------
 def test_ring_buffer_bounds(monkeypatch):
     monkeypatch.setenv("GS_TELEMETRY", "1")
     monkeypatch.delenv("GS_TRACE_DIR", raising=False)

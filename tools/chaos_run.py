@@ -455,6 +455,37 @@ def assert_flight_recorder(num_kills: int) -> dict:
     }
 
 
+def run_gslint() -> dict:
+    """One gslint pass over the package (tools/gslint), returning the
+    schema-validated JSON report. Used twice by main(): before and
+    after the soak — the linter reads only committed source, so the
+    soak's generated artifacts (tuning caches, ledgers, checkpoints,
+    demotion logs) must not change a single finding."""
+    from tools.gslint import report_json, run_lint, validate_report
+
+    report = report_json(run_lint(["gelly_streaming_tpu"]),
+                         ["gelly_streaming_tpu"])
+    problems = validate_report(report)
+    assert problems == [], problems
+    return report
+
+
+def assert_gslint_hermetic(before: dict, after: dict) -> dict:
+    """The gslint-hermetic leg: a clean tree stays clean through the
+    whole chaos soak (no rule may depend on runtime state), and the
+    verdict is bit-identical finding-for-finding."""
+    assert after["findings"] == before["findings"], (
+        "gslint verdict changed across the soak — a rule is reading "
+        "runtime state")
+    assert after["counts"]["new"] == 0, (
+        "tree not gslint-clean: %d new finding(s)"
+        % after["counts"]["new"])
+    return {"findings": after["counts"]["total"],
+            "baselined": after["counts"]["baselined"],
+            "new": after["counts"]["new"],
+            "hermetic": True}
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--edges", type=int, default=524288)
@@ -494,6 +525,7 @@ def main():
         os.environ.setdefault(k, v)
     resilience.reset_demotions()
 
+    lint_before = run_gslint()
     src, dst = make_stream(args.edges, args.vertices)
     num_w = -(-args.edges // args.eb)
     with tempfile.TemporaryDirectory(prefix="gs-chaos-") as workdir:
@@ -566,6 +598,10 @@ def main():
         raise SystemExit("chaos schedule incomplete: %s never fired"
                          % sorted(missing))
 
+    # gslint-hermetic leg: the invariant checker's verdict must be
+    # bit-identical after the soak's generated artifacts
+    gl = assert_gslint_hermetic(lint_before, run_gslint())
+
     summary = {
         "edges": args.edges, "edge_bucket": args.eb,
         "vertices": args.vertices,
@@ -573,6 +609,7 @@ def main():
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
+        "gslint_leg": gl,
         "fault_classes_fired": sorted(classes),
         "demotions": resilience.demotion_events(),
         "parity": True,

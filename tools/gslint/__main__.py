@@ -1,0 +1,79 @@
+"""CLI for the project invariant checker.
+
+    python -m tools.gslint [TARGET ...]       lint (default: the package)
+    python -m tools.gslint --json -           machine-readable report
+    python -m tools.gslint --write-baseline   regenerate the baseline
+    python -m tools.gslint --knob-table       print the README GS_* table
+    python -m tools.gslint --list-rules       rule ids and summaries
+
+Exit status = number of non-baselined findings (capped at 125).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import (BASELINE_PATH, DEFAULT_TARGET, report_json, run_lint,
+               write_baseline)
+from . import rules as rules_mod
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="gslint",
+                                 description=__doc__.split("\n")[0])
+    ap.add_argument("targets", nargs="*", default=[DEFAULT_TARGET])
+    ap.add_argument("--json", metavar="PATH",
+                    help="write the JSON report to PATH ('-' = stdout)")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file (default tools/gslint/"
+                         "baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write ALL current findings as the new "
+                         "baseline (policy: only ever shrink it)")
+    ap.add_argument("--knob-table", action="store_true",
+                    help="print the README knob table rendered from "
+                         "utils/knobs.py and exit")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.knob_table:
+        print(rules_mod.KnobRegistryRule.registry().render_table())
+        return 0
+    if args.list_rules:
+        for rule in rules_mod.all_rules():
+            print("%s  %-14s %s" % (rule.rule_id, rule.name, rule.doc))
+        return 0
+
+    targets = args.targets or [DEFAULT_TARGET]
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else args.baseline
+    findings = run_lint(targets, baseline_path=baseline)
+
+    if args.write_baseline:
+        n = write_baseline(findings, args.baseline)
+        print("gslint: baseline written: %d entries (%d findings) -> %s"
+              % (n, len(findings), args.baseline))
+        return 0
+
+    if args.json:
+        payload = json.dumps(report_json(findings, targets), indent=1)
+        if args.json == "-":
+            print(payload)
+        else:
+            with open(args.json, "w", encoding="utf-8") as f:
+                f.write(payload + "\n")
+    new = [f for f in findings if not f.baselined]
+    shown = findings if args.no_baseline else new
+    for f in shown:
+        print(f.render())
+    print("gslint: %d finding(s), %d baselined, %d new"
+          % (len(findings), len(findings) - len(new), len(new)))
+    return min(125, len(new))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
