@@ -45,6 +45,7 @@ from ..ops import triangles as tri_ops
 from ..ops import unionfind
 from ..utils import checkpoint
 from ..utils import faults
+from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
 from ..utils.interning import make_interner, parallel_intern_arrays
@@ -529,6 +530,7 @@ class StreamingAnalyticsDriver:
         windows are count-based `edge_bucket`-sized chunks (the
         ingestion-time analog at a fixed batch rate). `_starts` lets
         stream_file pass its already-computed window assignment."""
+        metrics.on_stream_start("driver")
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
         if _starts is not None or (
@@ -722,14 +724,19 @@ class StreamingAnalyticsDriver:
             if self._mesh_live():
                 from ..parallel.sharded import make_sharded_snapshot_scan
 
-                self._scan_cache[wb] = make_sharded_snapshot_scan(
+                fn = make_sharded_snapshot_scan(
                     self.mesh, self.vb, self.analytics,
                     deltas=self.emit_deltas)
             else:
-                self._scan_cache[wb] = _build_snapshot_scan(
+                fn = _build_snapshot_scan(
                     self.vb, self.analytics, deltas=self.emit_deltas,
                     egress=self._scan_egress(),
                     cap=delta_egress.egress_cap(self.eb, self.vb))
+            # compile watch (utils/metrics): every distinct abstract
+            # signature this program family sees counts against the
+            # O(log V) recompile envelope
+            self._scan_cache[wb] = metrics.wrap_jit("snapshot_scan",
+                                                    fn)
         return self._scan_cache[wb]
 
     def _run_batched(self, windows,
@@ -1050,9 +1057,12 @@ class StreamingAnalyticsDriver:
         def _boundary(at, chunk):
             # chunk boundary: cursors, the partial flag, and the
             # checkpoint move together (mirrors moved just before)
+            edges = sum(len(s) for _w, s, _d, _n in chunk)
             self.windows_done += len(chunk)
-            self.edges_done += sum(
-                len(s) for _w, s, _d, _n in chunk)
+            self.edges_done += edges
+            metrics.mark_window(len(chunk), edges, engine="driver",
+                                tier=tier,
+                                mesh_shape=self._mesh_shape())
             if closes_partial and at + len(chunk) >= num_w:
                 # the short final window lives in this chunk: the flag
                 # joins this boundary's state (and its checkpoint),
@@ -1770,6 +1780,10 @@ class StreamingAnalyticsDriver:
             self._attach_host_deltas(res, prev)
         self.windows_done += 1
         self.edges_done += len(src)
+        metrics.mark_window(
+            1, len(src), engine="driver",
+            tier=self._demoted_tier or self._base_tier(),
+            mesh_shape=self._mesh_shape())
         if self._ckpt_due():
             self._stage_ckpt()
         return res

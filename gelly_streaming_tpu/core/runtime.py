@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from .plan import OpNode
 from .types import csv_line, text_line
+from ..utils import metrics
 
 Record = Tuple[Any, int]  # (value, timestamp_ms)
 
@@ -59,6 +60,7 @@ class Executor:
 
     # ------------------------------------------------------------------
     def run(self) -> Dict[int, List[Record]]:
+        metrics.on_stream_start("runtime")
         # Run iteration loops first: their fixpoint evaluation memoizes
         # every body node's accumulated output, so no sink path can later
         # re-execute a stateful body operator with already-mutated state.
@@ -365,7 +367,11 @@ class Executor:
             panes: Dict[int, List[Any]] = defaultdict(list)
             for v, ts in records:
                 panes[ts - ts % slide].append(v)
-            return pane_kernel(panes, size, slide)
+            out = pane_kernel(panes, size, slide)
+            if metrics.enabled():  # arg is an O(n) pass over `out`
+                metrics.mark_window(len({ts for _, ts in out}),
+                                    len(records), engine="runtime")
+            return out
         groups: Dict[int, List[Any]] = defaultdict(list)
         for v, ts in records:
             for wstart in self._window_starts(ts, size, slide):
@@ -373,6 +379,7 @@ class Executor:
         out: List[Record] = []
         for wstart in sorted(groups):
             out.extend(kernel(groups[wstart], wstart + size - 1))
+        metrics.mark_window(len(groups), len(records), engine="runtime")
         return out
 
     # ------------------------------------------------------------------

@@ -95,6 +95,7 @@ from typing import Callable, Iterable, List, Optional
 
 from ..utils import faults
 from ..utils import knobs
+from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
 from ..utils.resilience import StageFailed, StageTimeout
@@ -586,6 +587,9 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
                 if nxt < len(items):
                     futures.append(_submit(items[nxt]))
                     nxt += 1
+                # backlog gauge for the health plane (no-op disarmed):
+                # prepped+transferred chunks waiting on dispatch
+                metrics.gauge_set("gs_inflight_chunks", len(futures))
                 _consume(item, dev, cell.get("tctx"))
     except Exception:
         # drain in-flight device work before surfacing the failure:

@@ -43,6 +43,7 @@ from . import segment as seg_ops
 from . import triangles as tri_ops
 from . import unionfind
 from ..utils import checkpoint
+from ..utils import metrics
 from ..utils import telemetry
 
 
@@ -94,6 +95,11 @@ class SummaryEngineBase:
     (exact triangle recount of one overflowing window)."""
 
     MAX_WINDOWS = 64
+    # tier label of this engine's mark_window health-plane marks —
+    # subclasses on another tier (sharded mesh, numpy host twin)
+    # override it so /healthz never claims the single-chip scan tier
+    # for a demoted or mesh-resident stream
+    METRICS_TIER = "fused_scan"
     # stream-chunk wire format; StreamSummaryEngine resolves it from
     # committed evidence (tri_ops.resolve_ingress), the sharded engine
     # keeps the standard format (its chunks are mesh-sharded)
@@ -145,7 +151,7 @@ class SummaryEngineBase:
 
     def state(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         """(degrees[vb], cc_labels[vb], odd[vb]) snapshots."""
-        deg, labels, cover = (np.asarray(x) for x in self._carry)
+        deg, labels, cover = (np.asarray(x) for x in self._carry)  # gslint: disable=host-sync (sanctioned snapshot boundary: the engine's state() d2h)
         odd = cover[: self.vb] == cover[self.vb + 1: 2 * self.vb + 1]
         return deg[: self.vb], labels[: self.vb], odd
 
@@ -159,7 +165,7 @@ class SummaryEngineBase:
         checkpoints are engine-interchangeable at equal buckets. When
         the online tuner is live, its learned state rides along so a
         resumed stream keeps its configuration."""
-        deg, labels, cover = (np.array(x) for x in self._carry)
+        deg, labels, cover = (np.array(x) for x in self._carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: state_dict's one d2h)
         state = {
             "edge_bucket": self.eb,
             "vertex_bucket": self.vb,
@@ -181,7 +187,7 @@ class SummaryEngineBase:
                 "window boundary" % (state["edge_bucket"],
                                      state["vertex_bucket"],
                                      self.eb, self.vb))
-        self.windows_done = int(state["windows_done"])
+        self.windows_done = int(state["windows_done"])  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
         self._closed_partial = bool(state["closed_partial"])
         self._carry = tuple(self._to_carry(a) for a in state["carry"])
         # .get: checkpoints from before the autotune key (and engines
@@ -275,7 +281,7 @@ class SummaryEngineBase:
     def warm_fallback(self) -> None:
         """Compile the overflow-recount path's base program so a skewed
         stream's first hub window doesn't compile mid-measurement."""
-        self._redo(np.array([0]), np.array([1]), 1, 1)
+        self._redo(np.array([0]), np.array([1]), 1, 1)  # gslint: disable=host-sync (host constants, not a device sync)
 
     def process(self, src: np.ndarray, dst: np.ndarray) -> list:
         """Fold the stream's `edge_bucket`-sized windows; returns one
@@ -285,8 +291,9 @@ class SummaryEngineBase:
         its partial trailing window (count-based tumbling semantics),
         so it must be the stream's final call — feed mid-stream chunks
         in edge_bucket multiples (enforced below)."""
-        src = np.asarray(src, np.int32)
-        dst = np.asarray(dst, np.int32)
+        metrics.on_stream_start(type(self).__name__)
+        src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+        dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         n = len(src)
         if n == 0:
             return []
@@ -353,15 +360,21 @@ class SummaryEngineBase:
             lo = (f_at + int(w)) * self.eb
             tri[w] = self._redo(src[lo:lo + self.eb],
                                 dst[lo:lo + self.eb],
-                                int(b_ovf[w]), int(k_ovf[w]))
+                                int(b_ovf[w]), int(k_ovf[w]))  # gslint: disable=host-sync (numpy-on-numpy: _materialize already d2h'd these slabs)
         for w in range(f_real):
             out.append({
-                "max_degree": int(mdeg[w]),
-                "num_components": int(ncomp[w]),
+                "max_degree": int(mdeg[w]),  # gslint: disable=host-sync (numpy-on-numpy after _materialize)
+                "num_components": int(ncomp[w]),  # gslint: disable=host-sync (numpy-on-numpy after _materialize)
                 "odd_cycle": bool(odd[w]),
-                "triangles": int(tri[w]),
+                "triangles": int(tri[w]),  # gslint: disable=host-sync (numpy-on-numpy after _materialize)
             })
         self.windows_done += f_real
+        # window-finalize mark (utils/metrics): throughput counters +
+        # the staleness clock the health watchdog reads
+        lo_e = f_at * self.eb
+        metrics.mark_window(
+            f_real, min((f_at + f_real) * self.eb, len(src)) - lo_e,
+            engine=type(self).__name__, tier=self.METRICS_TIER)
 
     def _run_window_rounds(self, src, dst, at0: int, hi_w: int,
                            wb: int, compact: bool, data, base: int,
@@ -587,7 +600,9 @@ class StreamSummaryEngine(SummaryEngineBase):
         def run(carry, src_w, dst_w, valid_w):
             return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
 
-        self._run = run
+        # compile watch (utils/metrics): distinct abstract signatures
+        # count against the O(log V) recompile envelope
+        self._run = metrics.wrap_jit("fused_scan", run)
         self._body = body
         self._run_c = None  # compact twin, built on first use
         if self.ingress == "compact":
@@ -615,7 +630,7 @@ class StreamSummaryEngine(SummaryEngineBase):
                     s16, d16, nvalid, eb_, vb_)
                 return jax.lax.scan(body, carry, (s_w, d_w, valid_w))
 
-            self._run_c = run_c
+            self._run_c = metrics.wrap_jit("fused_scan_compact", run_c)
         return self._run_c
 
     def _dispatch_async(self, s, d, valid):
@@ -631,7 +646,7 @@ class StreamSummaryEngine(SummaryEngineBase):
         return outs
 
     def _materialize(self, raw):
-        mdeg, ncomp, odd, tri, ovf = (np.array(x) for x in raw)
+        mdeg, ncomp, odd, tri, ovf = (np.array(x) for x in raw)  # gslint: disable=host-sync (sanctioned finalize boundary: the engine's ONE batched d2h per chunk)
         # single-chip scan has one overflow signal: report it as k_ovf
         return mdeg, ncomp, odd, tri, np.zeros_like(ovf), ovf
 

@@ -35,6 +35,7 @@ import numpy as np
 
 from . import ingress_pipeline
 from . import segment as seg_ops
+from ..utils import metrics
 from ..utils import telemetry
 
 DENSE_LIMIT = 2048
@@ -1179,13 +1180,26 @@ class TriangleWindowKernel:
         if impl == "native":
             counts = _native_count_stream_parallel(src, dst, self.eb)
             if counts is not None:
+                metrics.mark_window(len(counts), len(src),
+                                    engine="triangle_stream",
+                                    tier="native")
                 return counts
             impl = "host"  # stale library: numpy tier stands in
         if impl == "host":
             from . import host_triangles
 
-            return host_triangles.count_stream(src, dst, self.eb)
-        return self._count_stream_device(src, dst)
+            counts = host_triangles.count_stream(src, dst, self.eb)
+            metrics.mark_window(len(counts), len(src),
+                                engine="triangle_stream", tier="host")
+            return counts
+        # health-plane marks live ONLY at this top-level entry (all
+        # tiers, once per stream): the chunk loops underneath are
+        # shared with count_windows — the driver's flush path — whose
+        # windows the driver already marks at its own chunk boundary
+        counts = self._count_stream_device(src, dst)
+        metrics.mark_window(len(counts), len(src),
+                            engine="triangle_stream", tier="device")
+        return counts
 
     def _count_stream_device(self, src: np.ndarray,
                              dst: np.ndarray) -> list:

@@ -201,6 +201,7 @@ class HostSummaryEngine(scan_analytics.SummaryEngineBase):
     AUTOTUNE = False
     TUNABLE_INGRESS = False
     ingress = "standard"
+    METRICS_TIER = "host"
 
     def __init__(self, edge_bucket: int, vertex_bucket: int,
                  k_bucket: int = 0):

@@ -37,7 +37,7 @@ from .mesh import (SHARD_AXIS, make_mesh, mesh_padded_len,
 from ..ops import ingress_pipeline, scan_analytics
 from ..ops import segment as seg_ops
 from ..ops import triangles, unionfind
-from ..utils import faults, resilience, telemetry
+from ..utils import faults, metrics, resilience, telemetry
 
 
 # ----------------------------------------------------------------------
@@ -859,10 +859,17 @@ class ShardedTriangleWindowKernel:
         with telemetry.span("sharded.stream", tier="sharded",
                             engine="triangles", mesh=self.n,
                             windows=num_w, edges=len(src)):
-            return self._run_stack(
+            counts = self._run_stack(
                 s, d, valid,
                 lambda w: (src[w * eb:(w + 1) * eb],
                            dst[w * eb:(w + 1) * eb]))
+        # health-plane mark at this top-level entry only: _run_stack
+        # is shared with count_windows — the driver's flush path —
+        # whose windows the driver already marks at its chunk boundary
+        metrics.mark_window(len(counts), len(src),
+                            engine="sharded_triangles",
+                            tier="sharded", mesh_shape=[self.n])
+        return counts
 
     def count_windows(self, windows) -> list:
         """Exact counts of a list of (src, dst) window batches of
@@ -1249,6 +1256,8 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
     sharded over the mesh — one dispatch per MAX_WINDOWS windows.
     Triangle windows that overflow K or the exchange capacity are
     recounted exactly by the escalating per-window sharded kernel."""
+
+    METRICS_TIER = "sharded"
 
     def __init__(self, mesh, edge_bucket: int, vertex_bucket: int,
                  k_bucket: int = 0):

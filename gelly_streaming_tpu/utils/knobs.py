@@ -280,6 +280,42 @@ register("GS_TRACE_DURABLE", "bool", True,
          help="`0` drops the per-durable-event fsync (append still "
               "happens; only the power-loss window widens)")
 
+# live health plane (utils/metrics.py + utils/healthz.py)
+register("GS_METRICS", "bool", False,
+         help="arm the streaming metrics registry "
+              "(`utils/metrics.py`): stage latency histograms, "
+              "window/edge throughput, retry/demotion/fault/"
+              "checkpoint counters and the compile & memory watch, "
+              "fed from the flight-recorder hooks; off (the default) "
+              "every hook is a guarded no-op and the hot path is "
+              "bit-identical",
+         default_text="0 (off)")
+register("GS_METRICS_PORT", "int", 0, lo=0, hi=65535,
+         help="serve `/metrics` (Prometheus text) and `/healthz` "
+              "(JSON) from a stdlib http daemon thread on this "
+              "127.0.0.1 port (`utils/healthz.py`); 0 (default) = no "
+              "server — the registry still records when GS_METRICS=1",
+         default_text="0 (off)")
+register("GS_METRICS_SERIES", "int", 64, lo=1,
+         help="label-set cardinality bound per metric name: beyond "
+              "it new label sets collapse into one `overflow` series "
+              "(each DISTINCT collapsed set counts once in "
+              "`gs_metrics_dropped_series_total`), so a tenant-shaped "
+              "label can never grow the registry unboundedly")
+register("GS_METRICS_COMPILE_BASE", "int", 8, lo=1,
+         help="base compile allowance per jitted function in the "
+              "recompile watch: a function may compile `base + "
+              "log2(max/min observed arg size) + 1` times (the "
+              "O(log V) bucket-growth envelope) before a durable "
+              "`recompile_storm` event fires")
+register("GS_HEALTH_STALE_S", "float", 30.0, lo=0.0,
+         help="staleness watchdog deadline: with the metrics plane "
+              "armed, no window finalizing for this many seconds "
+              "flips `/healthz` to `degraded` and writes a durable "
+              "`health_degraded` event (the wedged-tunnel detector); "
+              "0 disables the watchdog",
+         default_text="30")
+
 
 # ----------------------------------------------------------------------
 # docs rendering (README table; gslint R3 diffs it back)

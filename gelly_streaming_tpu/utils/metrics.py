@@ -1,0 +1,638 @@
+"""Streaming metrics registry — the live half of the observability
+plane (the flight recorder, utils/telemetry.py, is the post-mortem
+half).
+
+A process-global, thread-safe registry of **counters**, **gauges**,
+and **bounded histograms**, each keyed by a label set (engine, tier,
+stage, mesh_shape — tenant-ready: labels are an open dict). It is fed
+two ways:
+
+- From the EXISTING telemetry hooks: this module registers a sink
+  with utils/telemetry (telemetry.register_sink), so every span,
+  counter, gauge and event the instrumented layers already emit —
+  ingress prep/h2d/dispatch/finalize stage spans, stage retries, tier
+  demotions, injected faults, checkpoints, resumes — lands in the
+  registry with no new call sites. The sink is consulted even with
+  `GS_TELEMETRY=0`: arming the metrics plane never requires arming
+  the ledger.
+- From a handful of explicit marks on the streaming layers:
+  `mark_window()` at every window-finalize OWNER (the driver's chunk
+  boundary, SummaryEngineBase._finalize_summaries, the triangle
+  kernels' top-level count_stream entries — never the chunk loops
+  underneath, which also serve the driver's flush path and would
+  double-count) drives window/edge throughput AND the staleness
+  clock the health watchdog reads; the ingress pipeline sets the
+  in-flight/backlog gauges.
+
+Plus the **compile & memory watch**:
+
+- `wrap_jit(name, fn)` wraps a jitted entry point; each call computes
+  the abstract shape signature of its arguments and counts a compile
+  whenever a NEW signature appears (jit compiles exactly per abstract
+  signature). A function whose compile count exceeds the O(log V)
+  bucket-growth envelope — `GS_METRICS_COMPILE_BASE +
+  log2(max/min observed argument size) + 1` — stamps a durable
+  `recompile_storm` event: doubling buckets stay inside the envelope
+  by construction (k doublings ⇒ size ratio 2^(k-1) ⇒ allowance
+  ≥ base + k), a shape-churning caller trips it. This is the runtime
+  enforcement of the O(log V) recompile claim core/driver.py:27 and
+  ops/triangles.py stake their perf semantics on.
+- `sample_memory()` snapshots `jax.live_arrays()` (count + bytes) and
+  each device's `memory_stats()` into HBM/host gauges where the
+  backend supports them (tools/endurance_run.py's leak detector).
+
+Zero-overhead contract (same discipline as the flight recorder): with
+`GS_METRICS=0` (the default) every entry point is a guarded no-op, the
+telemetry sink reports inactive, and the hot path is bit-identical —
+asserted by tests/test_metrics.py digest parity on the 524K/32768 CPU
+row. The armed overhead bar (≤1.05×) is committed to PERF_cpu.json's
+`metrics` section by tools/profile_kernels.py.
+
+Knobs (utils/knobs.py):
+    GS_METRICS               0 (default) = disarmed no-ops; 1 = record
+    GS_METRICS_PORT          /metrics + /healthz port (utils/healthz)
+    GS_METRICS_SERIES        label-set cardinality bound per metric
+    GS_METRICS_COMPILE_BASE  base compile allowance per function
+    GS_HEALTH_STALE_S        staleness watchdog deadline (seconds)
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from . import knobs
+from . import telemetry
+
+clock = time.monotonic  # health/staleness clock (injectable per call)
+
+_HIST_CAP = 512  # per-series duration reservoir (percentile source)
+
+# telemetry stage spans → the per-stage latency histogram's label
+_STAGE_SPANS = {
+    "ingress.prep": "prep",
+    "ingress.h2d": "h2d",
+    "ingress.dispatch": "dispatch",
+    "ingress.finalize": "finalize",
+}
+
+# durable/notable telemetry events → counters (the bounded event
+# vocabulary of the instrumented layers; anything else lands in the
+# generic gs_events_total{event=...} under the series bound)
+_EVENT_COUNTERS = {
+    "stage_retry": "gs_stage_retries_total",
+    "stage_timeout": "gs_stage_errors_total",
+    "stage_failed": "gs_stage_errors_total",
+    "tier_demotion": "gs_tier_demotions_total",
+    "fault_injected": "gs_faults_injected_total",
+    "checkpoint_saved": "gs_checkpoints_total",
+    "resume": "gs_resumes_total",
+    "fatal": "gs_fatal_events_total",
+}
+
+
+def enabled() -> bool:
+    """GS_METRICS arms the registry; off (the default) every entry
+    point — including the telemetry sink — is a guarded no-op."""
+    return knobs.get_bool("GS_METRICS")
+
+
+def max_series() -> int:
+    return knobs.get_int("GS_METRICS_SERIES")
+
+
+def stale_after_s() -> float:
+    return knobs.get_float("GS_HEALTH_STALE_S")
+
+
+# ----------------------------------------------------------------------
+# the process-global registry
+# ----------------------------------------------------------------------
+_OVERFLOW_KEY = (("overflow", "true"),)
+
+
+class _Registry:
+    """All mutable state behind one lock. One instance per process
+    (rebuilt by reset())."""
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.counters: Dict[Tuple[str, tuple], float] = {}
+        self.gauges: Dict[Tuple[str, tuple], float] = {}
+        self.hists: Dict[Tuple[str, tuple], dict] = {}
+        self.series: Dict[str, set] = {}   # name → label keys seen
+        self.dropped_seen: set = set()     # (name, labels) collapsed
+        self.dropped_series = 0
+        # compile watch: fn name → {count, sizes, allowed, storm}
+        self.compiles: Dict[str, dict] = {}
+        # health state (the staleness watchdog's substrate)
+        self.health = "ok"
+        self.last_finalize: Optional[float] = None
+        # (status, t, age_s) — bounded: an episodic stream flips
+        # twice per idle gap forever, and only the tail is served
+        self.transitions = deque(maxlen=64)
+        self.windows_total = 0
+        self.edges_total = 0
+        self.edges_per_s_ema: Optional[float] = None
+        self.engines: Dict[str, dict] = {}   # engine → tier/mesh info
+
+    def series_key(self, name: str, labels: tuple) -> tuple:
+        """Admit `labels` under the per-metric cardinality bound;
+        past the bound, new label sets collapse into one `overflow`
+        series so a tenant-shaped label can never grow the registry
+        without bound. `dropped_series` counts DISTINCT collapsed
+        label sets (first rejection only — a recurring over-bound
+        series marked every window must not inflate it), remembered
+        in a set itself bounded at 4x the series bound: past that the
+        counter saturates (undercounts) rather than grow memory."""
+        seen = self.series.setdefault(name, set())
+        if labels in seen:
+            return labels
+        if len(seen) >= max_series():
+            dropped = (name, labels)
+            if dropped not in self.dropped_seen \
+                    and len(self.dropped_seen) < 4 * max_series():
+                self.dropped_seen.add(dropped)
+                self.dropped_series += 1
+            seen.add(_OVERFLOW_KEY)
+            return _OVERFLOW_KEY
+        seen.add(labels)
+        return labels
+
+
+_REG: Optional[_Registry] = None
+_REG_LOCK = threading.Lock()
+
+
+def _reg() -> _Registry:
+    global _REG
+    if _REG is None:
+        with _REG_LOCK:
+            if _REG is None:
+                _REG = _Registry()
+    return _REG
+
+
+def reset() -> None:
+    """Test/tool hook: drop all recorded series and health state."""
+    global _REG
+    with _REG_LOCK:
+        _REG = None
+
+
+def _labelkey(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+# ----------------------------------------------------------------------
+# recording API
+# ----------------------------------------------------------------------
+def counter_inc(name: str, value: float = 1, **labels) -> None:
+    if not enabled():
+        return
+    reg = _reg()
+    with reg.lock:
+        key = (name, reg.series_key(name, _labelkey(labels)))
+        reg.counters[key] = reg.counters.get(key, 0.0) + value
+
+
+def gauge_set(name: str, value: float, **labels) -> None:
+    if not enabled():
+        return
+    reg = _reg()
+    with reg.lock:
+        key = (name, reg.series_key(name, _labelkey(labels)))
+        reg.gauges[key] = float(value)
+
+
+def observe(name: str, value: float, **labels) -> None:
+    """One histogram observation (bounded reservoir + count/sum)."""
+    if not enabled():
+        return
+    reg = _reg()
+    with reg.lock:
+        key = (name, reg.series_key(name, _labelkey(labels)))
+        h = reg.hists.get(key)
+        if h is None:
+            h = reg.hists[key] = {
+                "count": 0, "sum": 0.0,
+                "samples": deque(maxlen=_HIST_CAP)}
+        h["count"] += 1
+        h["sum"] += value
+        h["samples"].append(value)
+
+
+# ----------------------------------------------------------------------
+# snapshots (tests, /healthz, /metrics)
+# ----------------------------------------------------------------------
+def counters() -> Dict[Tuple[str, tuple], float]:
+    reg = _reg()
+    with reg.lock:
+        return dict(reg.counters)
+
+
+def gauges() -> Dict[Tuple[str, tuple], float]:
+    reg = _reg()
+    with reg.lock:
+        return dict(reg.gauges)
+
+
+def histogram(name: str, **labels) -> Optional[dict]:
+    """(count, sum, p50/p95/p99) of one histogram series, or None."""
+    reg = _reg()
+    with reg.lock:
+        h = reg.hists.get((name, _labelkey(labels)))
+        if h is None:
+            return None
+        pct = telemetry.percentiles(h["samples"])
+        return {"count": h["count"], "sum": h["sum"],
+                "p50": pct[50], "p95": pct[95], "p99": pct[99]}
+
+
+def _fmt(v: float) -> str:
+    if isinstance(v, float) and v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return "%.9g" % v
+
+
+def _series(name: str, labels: tuple, extra: tuple = ()) -> str:
+    pairs = labels + extra
+    if not pairs:
+        return name
+    return "%s{%s}" % (name, ",".join(
+        '%s="%s"' % (k, v) for k, v in pairs))
+
+
+def render_prometheus() -> str:
+    """The registry in Prometheus text exposition format (counters,
+    gauges, histograms as summaries with nearest-rank quantiles),
+    deterministically ordered — the `/metrics` endpoint body and the
+    golden-file surface tests/test_metrics.py pins."""
+    reg = _reg()
+    lines: List[str] = []
+    with reg.lock:
+        for kind, table in (("counter", reg.counters),
+                            ("gauge", reg.gauges)):
+            by_name: Dict[str, list] = {}
+            for (name, labels), val in table.items():
+                by_name.setdefault(name, []).append((labels, val))
+            for name in sorted(by_name):
+                lines.append("# TYPE %s %s" % (name, kind))
+                for labels, val in sorted(by_name[name]):
+                    lines.append("%s %s"
+                                 % (_series(name, labels), _fmt(val)))
+        by_name = {}
+        for (name, labels), h in reg.hists.items():
+            by_name.setdefault(name, []).append((labels, h))
+        for name in sorted(by_name):
+            lines.append("# TYPE %s summary" % name)
+            for labels, h in sorted(by_name[name],
+                                    key=lambda x: x[0]):
+                pct = telemetry.percentiles(h["samples"])
+                for q, p in (("0.5", 50), ("0.95", 95), ("0.99", 99)):
+                    lines.append("%s %s" % (
+                        _series(name, labels, (("quantile", q),)),
+                        _fmt(pct[p])))
+                lines.append("%s %s" % (_series(name + "_sum", labels),
+                                        _fmt(h["sum"])))
+                lines.append("%s %d" % (
+                    _series(name + "_count", labels), h["count"]))
+        lines.append("# TYPE gs_metrics_dropped_series_total counter")
+        lines.append("gs_metrics_dropped_series_total %d"
+                     % reg.dropped_series)
+        lines.append("# TYPE gs_health_degraded gauge")
+        lines.append("gs_health_degraded %d"
+                     % (1 if reg.health == "degraded" else 0))
+    return "\n".join(lines) + "\n"
+
+
+# ----------------------------------------------------------------------
+# the telemetry sink: the existing span/counter/event hooks feed the
+# registry (registered at import time; self-gated on GS_METRICS)
+# ----------------------------------------------------------------------
+def _sink(rec: dict) -> None:
+    kind = rec.get("t")
+    name = rec.get("name", "")
+    if kind == "span":
+        dur = rec.get("dur")
+        if dur is None:
+            return
+        stage = _STAGE_SPANS.get(name)
+        if stage is not None:
+            observe("gs_stage_seconds", dur, stage=stage)
+            return
+        attrs = rec.get("a") or {}
+        edges = attrs.get("edges")
+        if edges:
+            observe("gs_round_seconds", dur, span=name)
+            counter_inc("gs_round_edges_total", edges, span=name)
+    elif kind == "event":
+        cname = _EVENT_COUNTERS.get(name)
+        attrs = rec.get("a") or {}
+        if cname is not None:
+            labels = {}
+            if cname == "gs_stage_errors_total":
+                labels["kind"] = name
+            if "stage" in attrs:
+                labels["stage"] = attrs["stage"]
+            counter_inc(cname, 1, **labels)
+        else:
+            counter_inc("gs_events_total", 1, event=name)
+    elif kind == "counter":
+        counter_inc("gs_" + name.replace(".", "_"),
+                    rec.get("value", 1))
+    elif kind == "gauge":
+        gauge_set("gs_" + name.replace(".", "_"),
+                  rec.get("value", 0))
+
+
+telemetry.register_sink(_sink, enabled)
+
+
+# ----------------------------------------------------------------------
+# window-finalize marks + health state (the wedged-tunnel detector)
+# ----------------------------------------------------------------------
+def on_stream_start(engine: str = "driver") -> None:
+    """Stream entry mark: re-anchors the staleness clock (a stream
+    that never finalizes its FIRST window is just as wedged as one
+    that stops mid-way — and a stream starting long after the
+    previous one finalized must not inherit that stale clock and get
+    flagged before its first window is even due), registers `engine`
+    on /healthz before its first finalize, and brings up the endpoint
+    when GS_METRICS_PORT asks for one."""
+    if not enabled():
+        return
+    reg = _reg()
+    with reg.lock:
+        reg.engines.setdefault(engine, {})
+        reg.last_finalize = clock()
+    _maybe_serve()
+
+
+def mark_window(windows: int, edges: int, engine: str = "driver",
+                tier: Optional[str] = None,
+                mesh_shape: Optional[list] = None,
+                now: Optional[float] = None) -> None:
+    """One window-finalize boundary: `windows` windows covering
+    `edges` edges were finalized by `engine` on `tier`. Drives the
+    throughput counters/gauges AND resets the staleness clock; a
+    finalize arriving while health is `degraded` is the recovery
+    signal (durable `health_recovered` event)."""
+    if not enabled():
+        return
+    reg = _reg()
+    now = clock() if now is None else now
+    recovered_age = None
+    with reg.lock:
+        prev = reg.last_finalize
+        reg.last_finalize = now
+        reg.windows_total += windows
+        reg.edges_total += edges
+        if prev is not None and now > prev:
+            rate = edges / (now - prev)
+            ema = reg.edges_per_s_ema
+            reg.edges_per_s_ema = (rate if ema is None
+                                   else 0.7 * ema + 0.3 * rate)
+        info = reg.engines.setdefault(engine, {})
+        if tier is not None:
+            info["tier"] = tier
+        if mesh_shape is not None:
+            info["mesh_shape"] = list(mesh_shape)
+        info["windows"] = info.get("windows", 0) + windows
+        if reg.health == "degraded":
+            reg.health = "ok"
+            recovered_age = (now - prev) if prev is not None else 0.0
+            reg.transitions.append(("ok", now, round(recovered_age, 3)))
+    labels = {"engine": engine}
+    if tier is not None:
+        labels["tier"] = tier
+    counter_inc("gs_windows_finalized_total", windows, **labels)
+    counter_inc("gs_edges_total", edges, **labels)
+    if recovered_age is not None:
+        telemetry.event("health_recovered", durable=True,
+                        engine=engine, gap_s=round(recovered_age, 3))
+    _maybe_serve()
+
+
+def check_staleness(now: Optional[float] = None) -> str:
+    """The staleness watchdog body (called by the utils/healthz
+    watchdog thread; `now` injectable for tests): no finalize within
+    GS_HEALTH_STALE_S of the last one flips health to `degraded` and
+    stamps a durable `health_degraded` event — once per episode."""
+    if not enabled():
+        return "ok"
+    stale = stale_after_s()
+    reg = _reg()
+    flipped_age = None
+    with reg.lock:
+        if stale > 0 and reg.last_finalize is not None \
+                and reg.health == "ok":
+            now = clock() if now is None else now
+            age = now - reg.last_finalize
+            if age > stale:
+                reg.health = "degraded"
+                flipped_age = age
+                reg.transitions.append(
+                    ("degraded", now, round(age, 3)))
+        status = reg.health
+    if flipped_age is not None:
+        telemetry.event("health_degraded", durable=True,
+                        age_s=round(flipped_age, 3), stale_s=stale)
+    return status
+
+
+def health_snapshot(now: Optional[float] = None) -> dict:
+    """The `/healthz` JSON body: current status, per-engine tier and
+    mesh shape, last-finalized-window age, backlog, throughput, the
+    demotion log tail, and the run-ledger status."""
+    from . import resilience
+
+    reg = _reg()
+    now = clock() if now is None else now
+    with reg.lock:
+        age = (None if reg.last_finalize is None
+               else round(now - reg.last_finalize, 3))
+        backlog = reg.gauges.get(("gs_inflight_chunks", ()), 0.0)
+        snap = {
+            "status": reg.health,
+            "last_finalize_age_s": age,
+            "stale_after_s": stale_after_s(),
+            "windows_finalized": reg.windows_total,
+            "edges_total": reg.edges_total,
+            "edges_per_s_ema": (None if reg.edges_per_s_ema is None
+                                else round(reg.edges_per_s_ema)),
+            "backlog_chunks": backlog,
+            "engines": {k: dict(v) for k, v in reg.engines.items()},
+            "transitions": [list(t)
+                            for t in list(reg.transitions)[-8:]],
+            "compiles": {
+                name: {"count": c["count"],
+                       "allowed": c.get("allowed"),
+                       "storm": c["storm"]}
+                for name, c in reg.compiles.items()},
+        }
+    snap["demotions"] = resilience.demotion_events()[-5:]
+    snap["trace"] = telemetry.trace_id()
+    snap["ledger"] = telemetry.ledger_path()
+    return snap
+
+
+def _maybe_serve() -> None:
+    """Bring up the health endpoint once GS_METRICS_PORT asks for one
+    (lazy import: healthz imports this module)."""
+    if knobs.get_int("GS_METRICS_PORT") > 0:
+        from . import healthz
+
+        healthz.maybe_start()
+
+
+# ----------------------------------------------------------------------
+# compile watch
+# ----------------------------------------------------------------------
+def _leaf_sig(x):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("arr", tuple(int(s) for s in shape), str(dtype))
+    if isinstance(x, (list, tuple)):
+        return ("seq",) + tuple(_leaf_sig(e) for e in x)
+    if isinstance(x, dict):
+        return ("map",) + tuple((k, _leaf_sig(v))
+                                for k, v in sorted(x.items()))
+    return ("py", type(x).__name__)
+
+
+def _sig_size(sig) -> int:
+    """Total array elements under one signature — the 'V' of the
+    O(log V) envelope."""
+    if not isinstance(sig, tuple):
+        return 0
+    if sig and sig[0] == "arr":
+        n = 1
+        for d in sig[1]:
+            n *= max(d, 1)
+        return n
+    return sum(_sig_size(s) for s in sig)
+
+
+def abstract_sig(args, kwargs=None) -> tuple:
+    """Abstract shape signature of one call: array leaves reduce to
+    (shape, dtype) — exactly the identity jit compiles per."""
+    sig = tuple(_leaf_sig(a) for a in args)
+    if kwargs:
+        sig += tuple((k, _leaf_sig(v))
+                     for k, v in sorted(kwargs.items()))
+    return sig
+
+
+def note_compile(name: str, sig: tuple) -> None:
+    """Count one (re)compile of `name` at `sig` and enforce the
+    O(log V) bucket-growth envelope; the first compile past it stamps
+    a durable `recompile_storm` event (sticky per function)."""
+    if not enabled():
+        return
+    reg = _reg()
+    base = knobs.get_int("GS_METRICS_COMPILE_BASE")
+    size = max(1, _sig_size(sig))
+    storm = None
+    with reg.lock:
+        c = reg.compiles.setdefault(
+            name, {"count": 0, "lo": size, "hi": size, "storm": False})
+        c["count"] += 1
+        c["lo"] = min(c["lo"], size)
+        c["hi"] = max(c["hi"], size)
+        growth = math.log2(c["hi"] / c["lo"])
+        c["allowed"] = base + int(growth) + 1
+        if c["count"] > c["allowed"] and not c["storm"]:
+            c["storm"] = True
+            storm = (c["count"], c["allowed"])
+    counter_inc("gs_compiles_total", 1, fn=name)
+    if storm is not None:
+        counter_inc("gs_recompile_storms_total", 1, fn=name)
+        telemetry.event("recompile_storm", durable=True, fn=name,
+                        compiles=storm[0], allowed=storm[1])
+
+
+_SIG_CAP = 4096  # per-wrapper distinct-signature memory bound
+
+
+def wrap_jit(name: str, fn):
+    """Wrap a jitted entry point: each call whose abstract shape
+    signature was not seen before counts as one compile of `name`
+    (jit compiles exactly per signature). Disarmed, the wrapper is a
+    set lookup + passthrough; results are identical either way. The
+    signature set is bounded at _SIG_CAP: a churner past it (deep in
+    storm territory — the sticky event fired thousands of compiles
+    earlier) keeps counting but stops being remembered, so the
+    watcher itself can't leak in the failure mode it detects (a
+    re-presented old signature may then over-count)."""
+    seen = set()
+
+    def wrapped(*args, **kwargs):
+        if enabled():
+            sig = abstract_sig(args, kwargs)
+            if sig not in seen:
+                if len(seen) < _SIG_CAP:
+                    seen.add(sig)
+                note_compile(name, sig)
+        return fn(*args, **kwargs)
+
+    wrapped.__name__ = getattr(fn, "__name__", name)
+    wrapped.__wrapped__ = fn
+    return wrapped
+
+
+def compile_report() -> Dict[str, dict]:
+    reg = _reg()
+    with reg.lock:
+        return {name: dict(c) for name, c in reg.compiles.items()}
+
+
+# ----------------------------------------------------------------------
+# memory watch
+# ----------------------------------------------------------------------
+def sample_memory() -> dict:
+    """Snapshot live-buffer and device-memory accounting. Always
+    RETURNS the sample (tools/endurance_run.py's leak detector reads
+    it directly); gauges are set only when armed. Backends without
+    memory_stats() simply contribute no device rows."""
+    out = {"live_buffers": None, "live_buffer_bytes": None,
+           "devices": []}
+    try:
+        import jax
+
+        arrs = jax.live_arrays()
+        total = 0
+        for a in arrs:
+            nbytes = getattr(a, "nbytes", 0) or 0
+            total += nbytes
+        out["live_buffers"] = len(arrs)
+        out["live_buffer_bytes"] = total
+        for dev in jax.devices():
+            try:
+                stats = dev.memory_stats()
+            except Exception:  # gslint: disable=except-hygiene (capability probe: backends without memory_stats contribute no row)
+                stats = None
+            if not stats:
+                continue
+            in_use = stats.get("bytes_in_use")
+            limit = stats.get("bytes_limit")
+            out["devices"].append({
+                "device": str(dev), "bytes_in_use": in_use,
+                "bytes_limit": limit})
+    except Exception as e:
+        telemetry.event("memory_sample_failed",
+                        error="%s: %s" % (type(e).__name__, e))
+        return out
+    if enabled():
+        if out["live_buffers"] is not None:
+            gauge_set("gs_live_buffers", out["live_buffers"])
+            gauge_set("gs_live_buffer_bytes", out["live_buffer_bytes"])
+        for row in out["devices"]:
+            if row["bytes_in_use"] is not None:
+                gauge_set("gs_device_bytes_in_use",
+                          row["bytes_in_use"], device=row["device"])
+    return out

@@ -221,6 +221,36 @@ _REC_LOCK = threading.Lock()
 _TLS = threading.local()
 _HOOKS_INSTALLED = False
 
+# Downstream consumers of the record stream (the metrics registry,
+# utils/metrics.py): each entry is (sink_fn, active_fn). A sink sees
+# every record the hooks produce while ITS active_fn says so, even
+# with GS_TELEMETRY=0 — the flight-recorder hooks are the one
+# instrumentation surface every layer already feeds, so the metrics
+# plane rides them instead of duplicating call sites. With telemetry
+# AND every sink disarmed the hooks stay guarded no-ops.
+_SINKS: List[tuple] = []
+
+
+def register_sink(sink, active) -> None:
+    """Attach `sink(record_dict)` to the record stream, consulted
+    while `active()` is true. Idempotent per (sink, active) pair."""
+    with _REC_LOCK:
+        if (sink, active) not in _SINKS:
+            _SINKS.append((sink, active))
+
+
+def _sinks_active() -> bool:
+    for _fn, active in _SINKS:
+        if active():
+            return True
+    return False
+
+
+def _active() -> bool:
+    """True when anything consumes records: the recorder itself
+    (GS_TELEMETRY) or an armed sink (the metrics registry)."""
+    return enabled() or _sinks_active()
+
 
 def _rec() -> _Recorder:
     global _REC
@@ -340,11 +370,36 @@ def _record(kind: str, name: str, durable: bool = False,
     rec.update({k: v for k, v in fields.items() if v is not None})
     if not rec.get("a"):
         rec.pop("a", None)
-    if not _HOOKS_INSTALLED and trace_dir() is not None:
-        # a ledger-destined run must flush its ring at exit even if no
-        # durable event ever opens the file earlier
-        _install_exit_hooks()
-    _rec().add(rec, durable=durable)
+    if enabled():
+        if not _HOOKS_INSTALLED and trace_dir() is not None:
+            # a ledger-destined run must flush its ring at exit even if
+            # no durable event ever opens the file earlier
+            _install_exit_hooks()
+        _rec().add(rec, durable=durable)
+    dropped = []
+    for sink, active in list(_SINKS):
+        if active():
+            try:
+                sink(rec)
+            except Exception as exc:  # gslint: disable=except-hygiene (a broken metrics sink must never take down the stream it observes; it is dropped from the record path with a durable marker below)
+                with _REC_LOCK:
+                    if (sink, active) in _SINKS:
+                        _SINKS.remove((sink, active))
+                        dropped.append(exc)
+    for exc in dropped:
+        # the armed plane going dark must leave a visible scar, not
+        # silently freeze its gauges: stamp a durable event (the
+        # failed sink is already removed, so this re-entry terminates)
+        # AND a registry counter — with GS_TELEMETRY=0 the event
+        # no-ops (no ledger), but /metrics still shows the drop
+        event("metrics_sink_dropped", durable=True,
+              error=repr(exc)[:200])
+        try:
+            from . import metrics as _metrics
+
+            _metrics.counter_inc("gs_metrics_sink_dropped_total")
+        except Exception:  # gslint: disable=except-hygiene (the scar write itself must never take down the record path it marks)
+            pass
     return rec
 
 
@@ -383,7 +438,7 @@ class _Span:
         if self._pushed:
             _TLS.stack.pop()
             self._pushed = False
-        if enabled():
+        if _active():
             par = _parent_sid()
             a = dict(self.attrs) if self.attrs else {}
             if exc_type is not None:
@@ -421,12 +476,13 @@ class _Stopwatch:
         self._done = True
         elapsed = clock() - self.t0
         self.attrs["_elapsed"] = elapsed
-        if self.name is not None and enabled():
+        if self.name is not None and _active():
             a = dict(self.attrs)
             a.pop("_elapsed", None)
             a.update(extra)
             _record("span", self.name, ts=self.t0, dur=elapsed,
-                    sid=_rec().sid(), par=_parent_sid(), a=a or None)
+                    sid=_rec().sid() if enabled() else None,
+                    par=_parent_sid(), a=a or None)
         return elapsed
 
 
@@ -439,10 +495,11 @@ def record_span(name: str, t0: float, dur: float,
                 sid: Optional[int] = None, **attrs) -> None:
     """Record an already-measured interval (the worker-side ingress
     stages time themselves and report after the fact)."""
-    if not enabled():
+    if not _active():
         return
-    _record("span", name, ts=t0, dur=dur,
-            sid=sid if sid is not None else _rec().sid(),
+    if sid is None and enabled():
+        sid = _rec().sid()
+    _record("span", name, ts=t0, dur=dur, sid=sid,
             par=parent if parent is not None else _parent_sid(),
             a=attrs or None)
 
@@ -487,20 +544,20 @@ def event(name: str, durable: bool = False, **attrs) -> None:
     """A discrete happening. durable=True appends + fsyncs the record
     to the ledger immediately (demotions, kills, checkpoints, resumes
     — the post-mortem class that must survive a wedge)."""
-    if not enabled():
+    if not _active():
         return
     _record("event", name, ts=clock(), durable=durable,
             a=attrs or None)
 
 
 def counter(name: str, value: float = 1, **attrs) -> None:
-    if not enabled():
+    if not _active():
         return
     _record("counter", name, ts=clock(), value=value, a=attrs or None)
 
 
 def gauge(name: str, value: float, **attrs) -> None:
-    if not enabled():
+    if not _active():
         return
     _record("gauge", name, ts=clock(), value=value, a=attrs or None)
 

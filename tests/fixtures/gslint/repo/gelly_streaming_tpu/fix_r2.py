@@ -8,6 +8,7 @@ import jax
 from jax import lax
 
 from .utils import knobs
+from .utils import metrics
 
 _MEMO = {}
 
@@ -16,6 +17,7 @@ def _step(carry, x):
     flag = os.environ.get("GS_TELEMETRY")      # TP: frozen at trace
     t = time.perf_counter()                    # TP: trace-time clock
     k = knobs.get_bool("GS_AUTOTUNE")          # TP: frozen knob read
+    metrics.counter_inc("gs_edges_total", 1)   # TP: trace-time record
     return carry + x + len(_MEMO) + k, (flag, t)  # TP: module mutable
 
 
@@ -28,4 +30,5 @@ def host_only():
     # TN: same reads, never traced
     _MEMO["x"] = os.environ.get("GS_TELEMETRY")
     _MEMO["k"] = knobs.get_bool("GS_AUTOTUNE")
+    metrics.counter_inc("gs_edges_total", 1)
     return time.perf_counter()

@@ -157,6 +157,7 @@ def test_r2_true_positives(fixture_findings):
     assert "time.perf_counter" in msgs
     assert "_MEMO" in msgs
     assert "knobs.get_bool" in msgs
+    assert "metrics-registry" in msgs
 
 
 def test_r2_true_negatives(fixture_findings):

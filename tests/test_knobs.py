@@ -20,7 +20,9 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_MESH_WIRE_CHECK GS_AUTOTUNE GS_AUTOTUNE_ROUND "
        "GS_AUTOTUNE_EXPLORE GS_TUNE_CACHE GS_EGRESS GS_EGRESS_CAP "
        "GS_TELEMETRY GS_TRACE_DIR GS_TRACE_RING "
-       "GS_TRACE_DURABLE").split()
+       "GS_TRACE_DURABLE GS_METRICS GS_METRICS_PORT "
+       "GS_METRICS_SERIES GS_METRICS_COMPILE_BASE "
+       "GS_HEALTH_STALE_S").split()
 
 _GETTERS = {"int": knobs.get_int, "float": knobs.get_float,
             "bool": knobs.get_bool, "str": knobs.get_str,

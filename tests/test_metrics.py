@@ -1,0 +1,483 @@
+"""Live health plane suite (utils/metrics + utils/healthz):
+
+- registry semantics: counters/gauges/bounded histograms with label
+  sets, thread-safe, live-knob-gated;
+- label cardinality bounds (GS_METRICS_SERIES): overflow collapses
+  into one series instead of growing the registry;
+- Prometheus text-format golden file (the /metrics body);
+- /healthz endpoint: JSON schema, ok=200 / degraded=503, /metrics
+  content type, 404s;
+- staleness watchdog with an injectable clock: degraded after
+  GS_HEALTH_STALE_S without a finalize (durable `health_degraded`),
+  recovery on the next finalize (durable `health_recovered`);
+- recompile envelope: doubling bucket growth stays inside the
+  O(log V) envelope (true negative), a shape-churning toy loop trips
+  a durable `recompile_storm` (true positive);
+- the telemetry-sink feed: stage spans → latency histograms and
+  events → counters with GS_TELEMETRY=0 (arming metrics never arms
+  the ledger);
+- `GS_METRICS=0` digest parity on the 524K/32768 CPU row (the
+  zero-overhead contract; the committed armed-overhead evidence is
+  PERF_cpu.json's `metrics` section).
+"""
+
+import hashlib
+import json
+import os
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.utils import healthz, metrics, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GOLDEN = os.path.join(REPO, "tests", "fixtures",
+                      "metrics_prometheus.txt")
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Registry armed (no server, no ledger); reset before AND after
+    so no series leak across tests."""
+    monkeypatch.setenv("GS_METRICS", "1")
+    monkeypatch.delenv("GS_TELEMETRY", raising=False)
+    monkeypatch.delenv("GS_METRICS_PORT", raising=False)
+    metrics.reset()
+    yield
+    metrics.reset()
+
+
+def _stream(num_edges, num_vertices, seed=7):
+    from bench import make_stream
+
+    return make_stream(num_edges, num_vertices, seed)
+
+
+# ----------------------------------------------------------------------
+# registry semantics
+# ----------------------------------------------------------------------
+def test_counter_gauge_histogram_semantics(armed):
+    metrics.counter_inc("gs_edges_total", 100, engine="driver")
+    metrics.counter_inc("gs_edges_total", 28, engine="driver")
+    metrics.counter_inc("gs_edges_total", 5, engine="other")
+    metrics.gauge_set("gs_inflight_chunks", 3)
+    metrics.gauge_set("gs_inflight_chunks", 1)
+    for ms in (1, 2, 3, 4):
+        metrics.observe("gs_stage_seconds", ms / 1e3, stage="prep")
+    c = metrics.counters()
+    assert c[("gs_edges_total", (("engine", "driver"),))] == 128
+    assert c[("gs_edges_total", (("engine", "other"),))] == 5
+    assert metrics.gauges()[("gs_inflight_chunks", ())] == 1.0
+    h = metrics.histogram("gs_stage_seconds", stage="prep")
+    assert h["count"] == 4
+    assert h["sum"] == pytest.approx(0.010)
+    # nearest-rank over [1,2,3,4] ms
+    assert (h["p50"], h["p95"], h["p99"]) == (0.002, 0.004, 0.004)
+    assert metrics.histogram("gs_stage_seconds", stage="h2d") is None
+
+
+def test_disarmed_is_inert(monkeypatch):
+    monkeypatch.setenv("GS_METRICS", "0")
+    metrics.reset()
+    try:
+        metrics.counter_inc("gs_edges_total", 1)
+        metrics.gauge_set("g", 1)
+        metrics.observe("h", 1)
+        metrics.mark_window(1, 10)
+        metrics.note_compile("f", ())
+        assert metrics.counters() == {}
+        assert metrics.gauges() == {}
+        assert metrics.health_snapshot()["windows_finalized"] == 0
+    finally:
+        metrics.reset()
+
+
+def test_label_cardinality_bound(armed, monkeypatch):
+    monkeypatch.setenv("GS_METRICS_SERIES", "4")
+    for i in range(10):
+        metrics.counter_inc("gs_edges_total", 1, tenant="t%d" % i)
+    series = [labels for (name, labels) in metrics.counters()
+              if name == "gs_edges_total"]
+    # 4 admitted + the one overflow series
+    assert len(series) == 5
+    overflow = metrics.counters()[
+        ("gs_edges_total", (("overflow", "true"),))]
+    assert overflow == 6
+    # known series keep accumulating normally past the bound
+    metrics.counter_inc("gs_edges_total", 1, tenant="t0")
+    assert metrics.counters()[
+        ("gs_edges_total", (("tenant", "t0"),))] == 2
+    # a RECURRING over-bound label set counts once, not per
+    # observation (dropped_series sizes the bound, not the traffic)
+    metrics.counter_inc("gs_edges_total", 1, tenant="t9")
+    metrics.counter_inc("gs_edges_total", 1, tenant="t9")
+    assert "gs_metrics_dropped_series_total 6" \
+        in metrics.render_prometheus()
+
+
+# ----------------------------------------------------------------------
+# Prometheus text format (golden file)
+# ----------------------------------------------------------------------
+def _fixed_registry():
+    metrics.counter_inc("gs_edges_total", 524288, engine="driver",
+                        tier="scan")
+    metrics.counter_inc("gs_windows_finalized_total", 16,
+                        engine="driver", tier="scan")
+    metrics.counter_inc("gs_stage_retries_total", 2, stage="h2d")
+    metrics.gauge_set("gs_inflight_chunks", 3)
+    metrics.gauge_set("gs_live_buffers", 42)
+    for ms in (10, 20, 30, 40):
+        metrics.observe("gs_stage_seconds", ms / 1e3, stage="prep")
+
+
+def test_prometheus_golden_file(armed):
+    _fixed_registry()
+    got = metrics.render_prometheus()
+    with open(GOLDEN) as f:
+        assert got == f.read()
+
+
+def test_prometheus_parses_as_exposition(armed):
+    _fixed_registry()
+    for line in metrics.render_prometheus().splitlines():
+        assert line.startswith("# TYPE ") or " " in line
+        if not line.startswith("#"):
+            name_part, value = line.rsplit(" ", 1)
+            float(value)  # every sample value is a number
+            assert name_part.startswith("gs_")
+
+
+# ----------------------------------------------------------------------
+# /healthz + /metrics endpoint
+# ----------------------------------------------------------------------
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return resp.status, resp.read(), resp.headers
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers
+
+
+def test_healthz_endpoint_schema_and_codes(armed, monkeypatch):
+    metrics.mark_window(4, 4096, engine="driver", tier="scan")
+    srv = healthz.start(port=0)
+    try:
+        base = "http://127.0.0.1:%d" % srv.port
+        code, body, headers = _get(base + "/healthz")
+        assert code == 200
+        snap = json.loads(body)
+        for key, kind in (
+                ("status", str), ("windows_finalized", int),
+                ("edges_total", int), ("stale_after_s", float),
+                ("engines", dict), ("transitions", list),
+                ("demotions", list), ("compiles", dict),
+                ("backlog_chunks", float), ("trace", str)):
+            assert isinstance(snap[key], kind), (key, snap[key])
+        assert "last_finalize_age_s" in snap
+        assert "ledger" in snap
+        assert snap["status"] == "ok"
+        assert snap["engines"]["driver"]["tier"] == "scan"
+        code, body, headers = _get(base + "/metrics")
+        assert code == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert "gs_windows_finalized_total" in body.decode()
+        assert _get(base + "/nope")[0] == 404
+        # degraded flips the HTTP code to 503 (probe needs no JSON)
+        monkeypatch.setenv("GS_HEALTH_STALE_S", "0.000001")
+        code, body, _ = _get(base + "/healthz")
+        assert code == 503
+        assert json.loads(body)["status"] == "degraded"
+    finally:
+        healthz.stop()
+
+
+def test_healthz_not_started_without_port(armed):
+    assert healthz.maybe_start() is None
+
+
+# ----------------------------------------------------------------------
+# staleness watchdog (injectable clock)
+# ----------------------------------------------------------------------
+def test_staleness_watchdog_flip_and_recover(armed, monkeypatch,
+                                             tmp_path):
+    monkeypatch.setenv("GS_HEALTH_STALE_S", "5")
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        metrics.mark_window(1, 100, now=100.0)
+        assert metrics.check_staleness(now=104.0) == "ok"
+        assert metrics.check_staleness(now=106.0) == "degraded"
+        # sticky per episode: no second durable event
+        assert metrics.check_staleness(now=200.0) == "degraded"
+        metrics.mark_window(1, 100, now=201.0)  # recovery signal
+        assert metrics.health_snapshot()["status"] == "ok"
+        trans = metrics.health_snapshot()["transitions"]
+        assert [t[0] for t in trans] == ["degraded", "ok"]
+        # both durable events on disk, exactly once
+        names = []
+        with open(telemetry.ledger_path()) as f:
+            for line in f:
+                names.append(json.loads(line).get("name"))
+        assert names.count("health_degraded") == 1
+        assert names.count("health_recovered") == 1
+    finally:
+        telemetry.reset()
+
+
+def test_staleness_disabled_at_zero(armed, monkeypatch):
+    monkeypatch.setenv("GS_HEALTH_STALE_S", "0")
+    metrics.mark_window(1, 100, now=0.0)
+    assert metrics.check_staleness(now=1e9) == "ok"
+
+
+def test_stream_start_reanchors_stale_clock(armed, monkeypatch):
+    """A stream starting long after the previous one finalized must
+    not inherit the stale clock and get flagged before its first
+    window is even due."""
+    monkeypatch.setenv("GS_HEALTH_STALE_S", "5")
+    metrics.mark_window(1, 100, now=100.0)   # stream A's last window
+    metrics.clock = lambda: 200.0            # stream B starts at 200
+    try:
+        metrics.on_stream_start("driver")
+    finally:
+        metrics.clock = __import__("time").monotonic
+    assert metrics.check_staleness(now=203.0) == "ok"
+    assert metrics.check_staleness(now=206.0) == "degraded"
+
+
+def test_health_transitions_bounded(armed, monkeypatch):
+    """Episodic degrade/recover flips forever must not grow the
+    transition log without bound (only the tail is served)."""
+    monkeypatch.setenv("GS_HEALTH_STALE_S", "1")
+    for i in range(200):
+        t = 10.0 * i
+        metrics.mark_window(1, 100, now=t)
+        metrics.check_staleness(now=t + 2.0)  # flip degraded
+    reg = metrics._reg()
+    assert len(reg.transitions) <= 64
+    assert len(metrics.health_snapshot()["transitions"]) == 8
+
+
+def test_wrap_jit_signature_memory_bounded(armed, monkeypatch):
+    """The compile watcher itself must not leak in the churn failure
+    mode it detects: past _SIG_CAP distinct signatures the set stops
+    growing while the compile count keeps moving."""
+    monkeypatch.setattr(metrics, "_SIG_CAP", 16)
+    fn = metrics.wrap_jit("churn_bound", lambda x: x)
+    for n in range(1, 41):
+        fn(np.zeros(n, np.int32))
+    rep = metrics.compile_report()["churn_bound"]
+    assert rep["count"] == 40        # counting never stops
+    assert rep["storm"]
+    seen = next(c.cell_contents for c in fn.__closure__
+                if isinstance(c.cell_contents, set))
+    assert len(seen) == 16           # capped at _SIG_CAP
+    # passthrough intact past the cap
+    np.testing.assert_array_equal(fn(np.zeros(1, np.int32)),
+                                  np.zeros(1, np.int32))
+
+
+# ----------------------------------------------------------------------
+# recompile envelope
+# ----------------------------------------------------------------------
+def test_recompile_envelope_doubling_growth_is_clean(armed):
+    """True negative: O(log V) bucket doubling — ten doublings from
+    1K — stays inside the envelope."""
+    fn = metrics.wrap_jit("grower", lambda x: x)
+    for k in range(10, 20):
+        fn(np.zeros(1 << k, np.int32))
+    rep = metrics.compile_report()["grower"]
+    assert rep["count"] == 10
+    assert not rep["storm"]
+    assert rep["count"] <= rep["allowed"]
+
+
+def test_recompile_envelope_churn_trips_storm(armed, monkeypatch,
+                                              tmp_path):
+    """True positive: a shape-churning toy loop (same order of
+    magnitude, ever-new shapes) blows past base+log2(growth)+1 and
+    stamps ONE durable recompile_storm."""
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        fn = metrics.wrap_jit("churner", lambda x: x)
+        for n in range(1000, 1040):
+            fn(np.zeros(n, np.int32))
+        rep = metrics.compile_report()["churner"]
+        assert rep["storm"]
+        assert rep["count"] == 40
+        assert rep["count"] > rep["allowed"]
+        names = []
+        with open(telemetry.ledger_path()) as f:
+            for line in f:
+                names.append(json.loads(line).get("name"))
+        assert names.count("recompile_storm") == 1  # sticky
+    finally:
+        telemetry.reset()
+
+
+def test_wrap_jit_passthrough_and_dedupe(armed):
+    calls = []
+
+    def fn(x, flag=False):
+        calls.append(1)
+        return x * 2
+
+    w = metrics.wrap_jit("f", fn)
+    a = np.arange(4)
+    np.testing.assert_array_equal(w(a), a * 2)
+    np.testing.assert_array_equal(w(a + 1), (a + 1) * 2)
+    assert len(calls) == 2                       # every call runs
+    rep = metrics.compile_report()["f"]
+    assert rep["count"] == 1                     # one signature
+    w(np.arange(8))                              # new shape
+    assert metrics.compile_report()["f"]["count"] == 2
+    w(a, flag=True)                              # kwargs in the sig
+    assert metrics.compile_report()["f"]["count"] == 3
+
+
+# ----------------------------------------------------------------------
+# the telemetry-sink feed (GS_TELEMETRY stays 0)
+# ----------------------------------------------------------------------
+def test_sink_maps_spans_and_events_without_ledger(armed):
+    assert not telemetry.enabled()
+    t0 = telemetry.clock()
+    telemetry.record_span("ingress.prep", t0, 0.002)
+    telemetry.record_span("ingress.finalize", t0, 0.001)
+    with telemetry.span("fused_scan.round", edges=4096):
+        pass
+    telemetry.event("stage_retry", stage="h2d", attempt=1)
+    telemetry.event("tier_demotion", durable=True)
+    telemetry.event("checkpoint_saved")
+    assert telemetry.records() == []  # the ledger/ring stayed off
+    assert metrics.histogram("gs_stage_seconds",
+                             stage="prep")["count"] == 1
+    assert metrics.histogram("gs_stage_seconds",
+                             stage="finalize")["count"] == 1
+    assert metrics.histogram("gs_round_seconds",
+                             span="fused_scan.round")["count"] == 1
+    c = metrics.counters()
+    assert c[("gs_stage_retries_total", (("stage", "h2d"),))] == 1
+    assert c[("gs_tier_demotions_total", ())] == 1
+    assert c[("gs_checkpoints_total", ())] == 1
+    assert c[("gs_round_edges_total",
+              (("span", "fused_scan.round"),))] == 4096
+
+
+def test_broken_sink_dropped_with_visible_scar(armed):
+    """A sink that raises is removed from the record path (the stream
+    survives) but must leave a scar: `gs_metrics_sink_dropped_total`
+    on /metrics even with the ledger off."""
+    assert not telemetry.enabled()
+    calls = []
+
+    def bad_sink(rec):
+        calls.append(rec)
+        raise KeyError("malformed record")
+
+    telemetry.register_sink(bad_sink, lambda: True)
+    try:
+        telemetry.event("stage_retry", stage="h2d")   # kills bad_sink
+        telemetry.event("stage_retry", stage="h2d")   # survives
+        assert len(calls) == 1                        # dropped, not retried
+        assert metrics.counters()[
+            ("gs_metrics_sink_dropped_total", ())] == 1
+        assert "gs_metrics_sink_dropped_total 1" \
+            in metrics.render_prometheus()
+        # the registry's own sink kept recording after the drop
+        assert metrics.counters()[
+            ("gs_stage_retries_total", (("stage", "h2d"),))] == 2
+    finally:
+        with telemetry._REC_LOCK:
+            telemetry._SINKS[:] = [
+                s for s in telemetry._SINKS if s[0] is not bad_sink]
+
+
+def test_mark_window_drives_throughput_and_age(armed):
+    metrics.on_stream_start()
+    metrics.mark_window(4, 4000, engine="driver", tier="scan",
+                        now=10.0)
+    metrics.mark_window(4, 8000, engine="driver", tier="scan",
+                        now=12.0)
+    snap = metrics.health_snapshot(now=13.0)
+    assert snap["windows_finalized"] == 8
+    assert snap["edges_total"] == 12000
+    assert snap["last_finalize_age_s"] == 1.0
+    assert snap["edges_per_s_ema"] == 4000  # 8000 edges / 2 s
+    assert snap["engines"]["driver"]["windows"] == 8
+
+
+def test_sample_memory_reports_live_buffers(armed):
+    import jax.numpy as jnp
+
+    keep = jnp.arange(1024)  # noqa: F841 — a live buffer to count
+    sample = metrics.sample_memory()
+    assert sample["live_buffers"] >= 1
+    assert sample["live_buffer_bytes"] > 0
+    assert metrics.gauges()[("gs_live_buffers", ())] >= 1
+
+
+# ----------------------------------------------------------------------
+# engine integration + the zero-overhead contract
+# ----------------------------------------------------------------------
+def test_engine_feeds_registry_end_to_end(armed):
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    eng = StreamSummaryEngine(edge_bucket=1024, vertex_bucket=2048)
+    eng.MAX_WINDOWS = 2
+    src, dst = _stream(8 * 1024, 1024, seed=3)
+    eng.process(src, dst)
+    c = metrics.counters()
+    key = ("gs_windows_finalized_total",
+           (("engine", "StreamSummaryEngine"),
+            ("tier", "fused_scan")))
+    assert c[key] == 8
+    assert metrics.histogram("gs_stage_seconds",
+                             stage="prep")["count"] >= 4
+    assert "fused_scan" in metrics.compile_report()
+    assert metrics.health_snapshot()["status"] == "ok"
+
+
+def test_disarmed_digest_parity_524k_row(monkeypatch):
+    """GS_METRICS=0 vs 1 on the 524K/32768 CPU bench row: counts are
+    bit-identical (the registry observes, never participates). The
+    armed-overhead bound is committed evidence (PERF_cpu.json
+    `metrics`, tools/profile_kernels.py section_metrics)."""
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+
+    src, dst = _stream(524288, 65536)
+    monkeypatch.setenv("GS_METRICS", "0")
+    monkeypatch.setenv("GS_TELEMETRY", "0")
+    metrics.reset()
+    kern = TriangleWindowKernel(edge_bucket=32768,
+                                vertex_bucket=65536)
+    base = kern.count_stream(src, dst)
+    assert metrics.counters() == {}       # disarmed: nothing recorded
+    monkeypatch.setenv("GS_METRICS", "1")
+    metrics.reset()
+    try:
+        armed_counts = kern.count_stream(src, dst)
+        observed = metrics.health_snapshot()["windows_finalized"]
+    finally:
+        metrics.reset()
+    digest = lambda c: hashlib.sha256(  # noqa: E731
+        np.asarray(c, np.int64).tobytes()).hexdigest()
+    assert digest(base) == digest(armed_counts)
+    assert observed == len(base)          # armed: every window seen
+
+
+def test_committed_metrics_section_meets_the_bar():
+    """The committed PERF_cpu.json `metrics` section holds the
+    acceptance bar: parity true, armed overhead ≤ 1.05×."""
+    with open(os.path.join(REPO, "PERF_cpu.json")) as f:
+        meta = json.load(f).get("metrics")
+    assert meta, "PERF_cpu.json is missing the metrics section"
+    assert meta["parity"] is True
+    assert meta["overhead_ratio"] <= 1.05
+    assert meta["num_edges"] == 524288
+    assert meta["edge_bucket"] == 32768

@@ -24,6 +24,7 @@ def _load_tool(name):
 perf_schema = _load_tool("perf_schema")
 update_perf_md = _load_tool("update_perf_md")
 trace_report = _load_tool("trace_report")
+bench_compare = _load_tool("bench_compare")
 
 
 # ----------------------------------------------------------------------
@@ -52,6 +53,8 @@ def test_schema_rejects_malformed_sections():
         "pipeline_stages": ["not-a-dict"],
         "host_reduce_error": "not-a-dict",
         "telemetry": [{"count": 3}],               # missing span
+        "regressions": [{"row": "x"}],             # missing field/...
+        "metrics": [{"engine": "t"}],              # dict, not a list
     }
     errors = perf_schema.validate(bad)
     joined = "\n".join(errors)
@@ -62,6 +65,13 @@ def test_schema_rejects_malformed_sections():
     assert "pipeline_stages" in joined
     assert "host_reduce_error" in joined
     assert "telemetry" in joined and "'span'" in joined
+    assert "regressions" in joined and "'ratio'" in joined
+    assert "metrics: expected a dict section" in joined
+    # a dict metrics section missing its required keys is also caught
+    errors = perf_schema.validate(
+        {"backend": "cpu", "metrics": {"engine": "t"}})
+    assert any("metrics" in e and "overhead_ratio" in e
+               for e in errors)
     assert perf_schema.validate([]) != []       # top level must be dict
     assert perf_schema.validate({"backend": 3})  # backend must be str
 
@@ -129,6 +139,14 @@ FIXTURE = {
     "telemetry_meta": {"engine": "triangle_stream+driver",
                        "parity": True, "overhead_ratio": 1.01,
                        "trace": "abc-123"},
+    "metrics": {"engine": "triangle_stream", "edge_bucket": 32768,
+                "num_edges": 524288, "parity": True,
+                "disarmed_edges_per_s": 24000000,
+                "armed_edges_per_s": 23500000,
+                "overhead_ratio": 1.021, "windows_observed": 16},
+    "regressions": [{"row": "bench[triangle]", "field": "value",
+                     "baseline": 100, "current": 50, "ratio": 0.5,
+                     "tolerance": 0.2}],
     "sharded": {"collectives": {
         "config": {"n": 8, "vb": 65536, "kb": 32, "cap": 4096},
         "backend": "cpu-virtual-mesh", "note": "modeled",
@@ -151,7 +169,8 @@ def test_render_covers_every_new_section():
                    "driver_ab", "triangle_stream",
                    "wb=64", "DEGRADED RUN", "Roofline",
                    "Ingress pipeline per-stage timing",
-                   "Flight recorder", "ingress.prep", "1.010"):
+                   "Flight recorder", "ingress.prep", "1.010",
+                   "Metrics plane", "1.021"):
         assert needle in block, needle
 
 
@@ -231,6 +250,132 @@ def test_trace_report_perfetto_and_render_round_trip(tmp_path):
     assert trace_report.main([LEDGER_FIXTURE, "--perfetto", out]) == 0
     with open(out) as f:
         assert json.load(f)["traceEvents"]
+
+
+# ----------------------------------------------------------------------
+# bench_compare: the perf regression sentry (tools/bench_compare.py)
+# ----------------------------------------------------------------------
+BENCH_ROWS = [
+    {"metric": "triangle 32768", "value": 9000000, "unit": "edges/s",
+     "pipeline_speedup": 3.1, "sync_prep_edges_per_s": 2900000},
+    {"metric": "reduce 8192", "value": 170000000, "unit": "edges/s",
+     "vs_baseline": 1.19},
+]
+
+
+def _write_jsonl(path, rows):
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+
+
+def test_bench_compare_unchanged_run_exits_zero(tmp_path, capsys):
+    base = str(tmp_path / "base.jsonl")
+    _write_jsonl(base, BENCH_ROWS)
+    assert bench_compare.main(["--baseline", base]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert perf_schema.validate(report) == []
+    assert report["regressions"] == []
+    assert report["rows_compared"] == 2
+
+
+def test_bench_compare_committed_baseline_self_compare():
+    """The acceptance pin: `--baseline BENCH_r05.json` (no --current)
+    exits 0 on the unchanged run."""
+    path = os.path.join(REPO, "BENCH_r05.json")
+    if not os.path.exists(path):
+        pytest.skip("BENCH_r05.json not committed")
+    assert bench_compare.main(["--baseline", path]) == 0
+
+
+def test_bench_compare_slowed_row_exits_nonzero(tmp_path, capsys):
+    base = str(tmp_path / "base.jsonl")
+    cur = str(tmp_path / "cur.jsonl")
+    _write_jsonl(base, BENCH_ROWS)
+    slowed = [dict(r) for r in BENCH_ROWS]
+    slowed[0]["value"] = int(slowed[0]["value"] * 0.5)  # -50%
+    _write_jsonl(cur, slowed)
+    rc = bench_compare.main(["--baseline", base, "--current", cur,
+                             "--out", str(tmp_path / "report.json")])
+    assert rc == 1
+    report = json.loads((tmp_path / "report.json").read_text())
+    assert perf_schema.validate(report) == []
+    regs = report["regressions"]
+    assert len(regs) == 1
+    assert regs[0]["row"] == "triangle 32768"
+    assert regs[0]["field"] == "value"
+    assert regs[0]["ratio"] == 0.5
+
+
+def test_bench_compare_ratio_field_and_tolerance(tmp_path):
+    base = str(tmp_path / "base.jsonl")
+    cur = str(tmp_path / "cur.jsonl")
+    _write_jsonl(base, BENCH_ROWS)
+    slowed = [dict(r) for r in BENCH_ROWS]
+    slowed[0]["pipeline_speedup"] = 2.6  # -16%: inside 0.2, not 0.1
+    _write_jsonl(cur, slowed)
+    assert bench_compare.main(
+        ["--baseline", base, "--current", cur]) == 0
+    assert bench_compare.main(
+        ["--baseline", base, "--current", cur,
+         "--tolerance", "0.1"]) == 1
+
+
+def test_bench_compare_reads_perf_json(tmp_path):
+    """PERF*.json baselines compare section rows (host_stream etc.)
+    and the metrics/telemetry_meta dict sections."""
+    base = str(tmp_path / "PERF_base.json")
+    cur = str(tmp_path / "PERF_cur.json")
+    with open(base, "w") as f:
+        json.dump(FIXTURE, f)
+    slowed = json.loads(json.dumps(FIXTURE))
+    slowed["metrics"]["armed_edges_per_s"] = 10
+    with open(cur, "w") as f:
+        json.dump(slowed, f)
+    assert bench_compare.main(
+        ["--baseline", base, "--current", base]) == 0
+    assert bench_compare.main(
+        ["--baseline", base, "--current", cur]) == 1
+
+
+def test_bench_compare_unreadable_inputs_exit_two(tmp_path):
+    empty = str(tmp_path / "empty.json")
+    with open(empty, "w") as f:
+        f.write("{}")
+    assert bench_compare.main(["--baseline", empty]) == 2
+    assert bench_compare.main(
+        ["--baseline", str(tmp_path / "missing.json")]) == 2
+
+
+# ----------------------------------------------------------------------
+# trace_report filters + empty-ledger exits
+# ----------------------------------------------------------------------
+def test_trace_report_filters(tmp_path):
+    records = trace_report.load(LEDGER_FIXTURE)
+    only = trace_report.filter_records(records, trace_id="fixture-1")
+    assert only and all(r.get("trace") == "fixture-1" for r in only)
+    none = trace_report.filter_records(records, trace_id="nope")
+    assert none == []
+    late = trace_report.filter_records(records, since=1e12)
+    assert all(r["t"] == "meta" for r in late)  # meta anchor kept
+
+
+def test_trace_report_exits_nonzero_on_empty_and_torn(tmp_path,
+                                                      capsys):
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert trace_report.main([str(empty)]) == 1
+    assert "no usable records" in capsys.readouterr().err
+    torn = tmp_path / "torn.jsonl"
+    torn.write_text('{"t": "span", "name": "torn')
+    assert trace_report.main([str(torn)]) == 1
+    assert "torn" in capsys.readouterr().err
+    # filters that match nothing are an error, not an empty table
+    assert trace_report.main([LEDGER_FIXTURE,
+                              "--trace-id", "nope"]) == 1
+    assert "nothing to report" in capsys.readouterr().err
+    assert trace_report.main([LEDGER_FIXTURE,
+                              "--trace-id", "fixture-1"]) == 0
 
 
 def test_update_perf_md_appends_block_when_markers_absent(tmp_path):

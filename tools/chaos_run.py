@@ -399,6 +399,136 @@ def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
     }
 
 
+def leg_health(workdir: str) -> dict:
+    """The health-plane drill: arm the metrics registry + /healthz
+    endpoint (utils/metrics + utils/healthz) over a fused-scan stream
+    that takes an h2d stall from the standard schedule, and assert
+
+      (a) /healthz flips to `degraded` (HTTP 503) while the stall
+          starves window finalizes past GS_HEALTH_STALE_S — within one
+          watchdog interval (the watchdog ticks at stale/4),
+      (b) it recovers to `ok` once the retried chunk finalizes,
+      (c) the matching durable `health_degraded` / `health_recovered`
+          events landed in the soak's run ledger, and
+      (d) a fault-free run with the plane ARMED is bit-identical to
+          the disarmed baseline (the GS_METRICS=0/1 parity contract
+          at drill scale; the committed 524K/32768 proof lives in
+          PERF_cpu.json's `metrics` section)."""
+    import threading
+    import time
+    import urllib.error
+    import urllib.request
+
+    from gelly_streaming_tpu.utils import healthz, metrics
+
+    eb, vb, num_w = 4096, 8192, 8
+    src, dst = make_stream(num_w * eb, vb, seed=17)
+
+    def make():
+        eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+        eng.MAX_WINDOWS = 2  # several chunks → finalizes spread out
+        return eng
+
+    baseline = make().process(src, dst)  # plane disarmed
+
+    env_prev = {k: os.environ.get(k)
+                for k in ("GS_METRICS", "GS_HEALTH_STALE_S",
+                          "GS_AUTOTUNE")}
+    os.environ["GS_METRICS"] = "1"
+    os.environ["GS_HEALTH_STALE_S"] = "0.4"
+    # static dispatch: an explored arm's compile pause mid-run would
+    # be indistinguishable from the stall this leg is timing
+    os.environ["GS_AUTOTUNE"] = "0"
+    metrics.reset()
+    srv = healthz.start(port=0)
+    try:
+        eng = make()
+        armed = eng.process(src, dst)  # also warms every program
+        if armed != baseline:
+            raise SystemExit("health leg: ARMED fault-free run "
+                             "diverged from the disarmed baseline")
+        eng.reset()
+        metrics.reset()  # clean transition log for the drill
+
+        out, codes = [], []
+        worker_err = []
+
+        def run():
+            # the standard h2d-stall class: hang 2.5s, cut by the
+            # soak's 1s stage deadline, retried clean
+            try:
+                with faults.inject(faults.FaultSpec(
+                        site="h2d", on_call=2, action="hang",
+                        seconds=2.5)) as plan:
+                    out.extend(eng.process(src, dst))
+                if not any(s == "h2d" for s, _n, _a in plan.fired):
+                    raise AssertionError(
+                        "health leg: the h2d stall never fired")
+            except BaseException as e:  # re-raised on the main thread
+                worker_err.append(e)
+
+        t = threading.Thread(target=run)
+        t.start()
+        url = "http://127.0.0.1:%d/healthz" % srv.port
+        while t.is_alive():
+            try:
+                with urllib.request.urlopen(url, timeout=1) as resp:
+                    codes.append(resp.status)
+            except urllib.error.HTTPError as e:
+                codes.append(e.code)
+            time.sleep(0.05)
+        t.join()
+        if worker_err:
+            raise worker_err[0]
+
+        if out != baseline:
+            raise SystemExit("health leg: the stalled+retried run "
+                             "diverged from the fault-free baseline")
+        if 503 not in codes:
+            raise SystemExit("health leg: /healthz never reported "
+                             "degraded during the h2d stall "
+                             "(codes=%r)" % codes)
+        trans = metrics.health_snapshot()["transitions"]
+        kinds = [t0[0] for t0 in trans]
+        if "degraded" not in kinds \
+                or "ok" not in kinds[kinds.index("degraded"):]:
+            raise SystemExit("health leg: no degraded→ok recovery in "
+                             "the transition log: %r" % trans)
+        # durable evidence in the soak ledger
+        telemetry.flush()
+        names = []
+        path = telemetry.ledger_path()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    try:
+                        names.append(json.loads(line).get("name"))
+                    except ValueError:
+                        pass
+        for needed in ("health_degraded", "health_recovered"):
+            if needed not in names:
+                raise SystemExit("health leg: durable %r event "
+                                 "missing from the run ledger"
+                                 % needed)
+        return {
+            "windows": num_w,
+            "healthz_port": srv.port,
+            "probes": len(codes),
+            "degraded_probes": codes.count(503),
+            "transitions": trans,
+            "armed_parity": True,
+            "parity": True,
+        }
+    finally:
+        healthz.stop()
+        metrics.reset()
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
 def assert_flight_recorder(num_kills: int) -> dict:
     """The flight-recorder durability leg: after the kill→resume
     drills, the run ledger (utils/telemetry, armed by main) must hold
@@ -556,6 +686,10 @@ def main():
                 seed=13)
             b = leg_engine(b_src, b_dst, args.engine_eb, engine_vb,
                            args.engine_windows, workdir)
+            # health-plane leg: /healthz flips degraded on a stalled
+            # h2d, recovers after the retry, durable events + armed
+            # digest parity
+            h = leg_health(workdir)
             # mesh leg: corrupt wire → retry, dead shard → demotion →
             # parity, n-shard checkpoint → 1-device + host-twin resume
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
@@ -607,6 +741,7 @@ def main():
         "vertices": args.vertices,
         "knobs": KNOBS,
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
+        "health_leg": h,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
         "gslint_leg": gl,

@@ -16,9 +16,13 @@ tools/scale_run.generate, 10x longer), all four analytics:
 
 Measured throughout: RSS at every window batch (from /proc/self/status
 — the bounded-memory ceiling), XLA compile events (jax_log_compiles —
-steady-state tail must be compile-free), and end-of-stream invariants
-(windows_done * window size == edges_done == NUM_EDGES; sum(degrees)
-== 2 * edges folded since the degree vector's birth).
+steady-state tail must be compile-free), the metrics plane's memory
+gauges (utils/metrics.sample_memory: live device buffers + bytes,
+sampled per round — the soak FAILS on monotonic live-buffer growth,
+the leak detector the resident-state megakernel work will lean on),
+and end-of-stream invariants (windows_done * window size ==
+edges_done == NUM_EDGES; sum(degrees) == 2 * edges folded since the
+degree vector's birth).
 
 Emits one JSON line per phase and writes ENDURANCE_r05.json
 (override with --out).
@@ -54,6 +58,33 @@ def rss_mb() -> float:
     return float("nan")
 
 
+def check_buffer_leak(samples) -> dict:
+    """The leak detector over phase B's per-round live-buffer counts:
+    quarter means that grow MONOTONICALLY (and meaningfully — jit
+    caches and carried state legitimately plateau) fail the soak."""
+    import numpy as np
+
+    counts = [s["live_buffers"] for s in samples
+              if s.get("live_buffers") is not None]
+    row = {"leg": "endurance_memory_gauges", "rounds": len(counts)}
+    if len(counts) < 8:
+        row.update({"ok": True, "note": "too few samples to judge"})
+        return row
+    quarters = [float(np.mean(q))
+                for q in np.array_split(np.array(counts), 4)]
+    monotonic = all(b > a for a, b in zip(quarters, quarters[1:]))
+    growth = quarters[-1] - quarters[0]
+    leak = monotonic and growth > max(8.0, 0.05 * quarters[0])
+    row.update({
+        "quarter_mean_live_buffers": [round(q, 1) for q in quarters],
+        "live_buffer_bytes_last": samples[-1].get("live_buffer_bytes"),
+        "ok": not leak,
+    })
+    assert not leak, ("monotonic live-buffer growth across the soak "
+                      "— a device-buffer leak: %r" % row)
+    return row
+
+
 def run(fixture: str, out_path: str) -> None:
     import logging
 
@@ -61,6 +92,7 @@ def run(fixture: str, out_path: str) -> None:
     import numpy as np
 
     from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+    from gelly_streaming_tpu.utils import metrics
 
     jax.config.update("jax_log_compiles", True)
     counter = CompileCounter()
@@ -133,17 +165,22 @@ def run(fixture: str, out_path: str) -> None:
     tail_compiles = 0
     seen_events = len(counter.events)
     deg_sum = None
+    mem_samples = []  # per-round memory gauges (the leak detector)
     for res in drv.stream_file(fixture, resume=True):
         windows += 1
         edges += res.num_edges
         if windows % 16 == 0:
             rss_samples.append(rss_mb())
+            mem_samples.append(metrics.sample_memory())
         new = len(counter.events) - seen_events
         seen_events = len(counter.events)
         if drv.windows_done > tail_from and new:
             tail_compiles += new
         deg_sum = res.degrees
     row = finish(drv, windows, edges, tail_compiles)
+    # memory-gauge leg: fail the soak on monotonic live-buffer growth
+    rows.append(check_buffer_leak(mem_samples))
+    print(json.dumps(rows[-1]), flush=True)
 
     # ---- invariants: nothing dropped, nothing double-counted
     assert drv.windows_done == total_windows, (

@@ -297,6 +297,13 @@ class JitPurityRule(Rule):
                         "telemetry call inside traced code records at "
                         "trace time only — record around the "
                         "dispatch, not inside it"))
+                elif cd.startswith("metrics."):
+                    out.append(self.finding(
+                        ctx, node,
+                        "metrics-registry call inside traced code "
+                        "records at trace time only (and its knob "
+                        "gate freezes) — mark around the dispatch, "
+                        "not inside it"))
                 elif cd.startswith("knobs."):
                     out.append(self.finding(
                         ctx, node,
@@ -519,6 +526,7 @@ class ThreadSharedRule(Rule):
         PKG + "/ops/delta_egress.py",
         PKG + "/parallel/sharded.py",
         PKG + "/utils/telemetry.py",
+        PKG + "/utils/metrics.py",
         PKG + "/utils/resilience.py",
         PKG + "/utils/faults.py",
         PKG + "/utils/interning.py",

@@ -50,6 +50,21 @@ LIST_SECTIONS = {
     # flight-recorder summary rows (utils/telemetry.summary():
     # per-span latency aggregates a profiler/chaos run commits)
     "telemetry": ("span", "count"),
+    # perf regression sentry rows (tools/bench_compare.py): one row
+    # per (baseline row, field) whose current/baseline ratio fell
+    # below tolerance — CI keys its red/green off this section
+    "regressions": ("row", "field", "baseline", "current", "ratio"),
+}
+
+# dict-shaped sections with required keys (telemetry_meta predates
+# this table and stays unvalidated for compatibility)
+DICT_SECTIONS = {
+    # metrics-plane overhead proof (tools/profile_kernels.py
+    # section_metrics): armed-vs-disarmed wall ratio + digest parity
+    # on the 524K/32768 row — the committed evidence for the
+    # GS_METRICS ≤1.05× bar
+    "metrics": ("engine", "parity", "overhead_ratio",
+                "disarmed_edges_per_s", "armed_edges_per_s"),
 }
 
 # A/B sections whose parity-true rows must claim a positive speedup
@@ -114,6 +129,15 @@ def validate(perf) -> list:
             continue
         if name in LIST_SECTIONS:
             _check_rows(name, val, errors)
+        elif name in DICT_SECTIONS:
+            if not isinstance(val, dict):
+                errors.append("%s: expected a dict section, got %s"
+                              % (name, type(val).__name__))
+                continue
+            for key in DICT_SECTIONS[name]:
+                if key not in val:
+                    errors.append("%s: missing required key %r"
+                                  % (name, key))
     return errors
 
 

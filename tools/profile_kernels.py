@@ -1188,6 +1188,65 @@ def section_telemetry(results: dict) -> None:
     results["telemetry_meta"] = meta
 
 
+def section_metrics(results: dict) -> None:
+    """Metrics-plane evidence (utils/metrics): the armed registry on
+    the 524K/32768 bench row must (a) change NO result — counts
+    asserted identical to the disarmed run — and (b) stay under the
+    1.05× armed-overhead bar (the plane records via the telemetry
+    sink with GS_TELEMETRY=0: arming metrics never arms the ledger).
+    The committed meta is the schema-validated `metrics` section
+    (tools/perf_schema.py) the ISSUE-8 acceptance bar reads."""
+    from bench import make_stream
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+    from gelly_streaming_tpu.utils import metrics
+
+    eb, vb = 32768, 65536
+    edges = int(os.environ.get("GS_TELEMETRY_EDGES", 524288))
+    src, dst = make_stream(edges, vb)
+    prev = {k: os.environ.get(k)
+            for k in ("GS_METRICS", "GS_TELEMETRY")}
+    try:
+        os.environ["GS_METRICS"] = "0"
+        os.environ["GS_TELEMETRY"] = "0"
+        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+        base = kern.count_stream(src, dst)  # warm + baseline counts
+        off_s = _timeit(lambda: kern.count_stream(src, dst),
+                        reps=7, warmup=2)
+        os.environ["GS_METRICS"] = "1"
+        metrics.reset()
+        armed = kern.count_stream(src, dst)
+        if list(armed) != list(base):
+            raise AssertionError(
+                "armed metrics registry changed the counts — the "
+                "zero-overhead contract is broken")
+        on_s = _timeit(lambda: kern.count_stream(src, dst),
+                       reps=7, warmup=1)
+        snap = metrics.health_snapshot()
+        prep = metrics.histogram("gs_stage_seconds", stage="prep")
+        meta = {
+            "engine": "triangle_stream",
+            "edge_bucket": eb, "num_edges": edges,
+            "parity": True,
+            "disarmed_edges_per_s": round(edges / off_s),
+            "armed_edges_per_s": round(edges / on_s),
+            "overhead_ratio": round(on_s / off_s, 3),
+            "health_status": snap["status"],
+            "windows_observed": snap["windows_finalized"],
+            "stage_prep_observations": (prep or {}).get("count", 0),
+            "compiles": {name: c["count"]
+                         for name, c in
+                         metrics.compile_report().items()},
+        }
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        metrics.reset()
+    results["metrics"] = meta
+
+
 def section_host_snapshot(results: dict) -> None:
     """Batched snapshot-analytics tiers: the driver's device scan vs
     the C++ carried union-find (native.snapshot_windows) — the
@@ -1427,6 +1486,7 @@ SECTIONS = {
     "egress_ab": section_egress_ab,
     "autotune": section_autotune,
     "telemetry": section_telemetry,
+    "metrics": section_metrics,
     "window": section_window,
     "host_stream": section_host_stream,
     "pipeline_stages": section_pipeline,

@@ -20,6 +20,11 @@ a crash left behind.
 Usage:
   python tools/trace_report.py LEDGER.jsonl [--perfetto out.json]
                                [--json] [--top N]
+                               [--trace-id ID] [--since SECONDS]
+
+An empty / torn-only ledger — or filters that match nothing — exits
+non-zero with a message naming the problem, never an empty percentile
+table (an unattended chip-window script must fail loudly there).
 """
 
 import argparse
@@ -50,6 +55,22 @@ def load(path: str) -> list:
             if isinstance(rec, dict) and "t" in rec:
                 records.append(rec)
     return records
+
+
+def filter_records(records: list, trace_id: str = None,
+                   since: float = None) -> list:
+    """Narrow a ledger: `trace_id` keeps one run's records (ledgers
+    under a reused GS_TRACE_DIR accumulate several; meta lines follow
+    their trace), `since` keeps records whose monotonic `ts` is at or
+    past that many seconds (meta lines are kept — they anchor the
+    clock mapping)."""
+    out = records
+    if trace_id is not None:
+        out = [r for r in out if r.get("trace") == trace_id]
+    if since is not None:
+        out = [r for r in out
+               if r["t"] == "meta" or float(r.get("ts", 0.0)) >= since]
+    return out
 
 
 def meta_of(records: list) -> dict:
@@ -199,11 +220,32 @@ def main(argv=None) -> int:
                     help="print the summary as JSON instead of text")
     ap.add_argument("--top", type=int, default=0,
                     help="limit the span table to the top N rows")
+    ap.add_argument("--trace-id", default=None,
+                    help="keep only records of this run trace ID")
+    ap.add_argument("--since", type=float, default=None,
+                    help="keep only records with monotonic ts >= this "
+                         "many seconds")
     args = ap.parse_args(argv)
 
     records = load(args.ledger)
     if not records:
-        print("no usable records in %s" % args.ledger, file=sys.stderr)
+        print("trace_report: no usable records in %s — the ledger is "
+              "empty or holds only torn lines (did the run arm "
+              "GS_TELEMETRY=1 and flush?)" % args.ledger,
+              file=sys.stderr)
+        return 1
+    records = filter_records(records, args.trace_id, args.since)
+    body = [r for r in records if r["t"] != "meta"]
+    if not body:
+        parts = []
+        if args.trace_id is not None:
+            parts.append("--trace-id %s" % args.trace_id)
+        if args.since is not None:
+            parts.append("--since %g" % args.since)
+        print("trace_report: no records%s in %s — nothing to report"
+              % ((" matching " + " ".join(parts)) if parts
+                 else " besides the meta anchor", args.ledger),
+              file=sys.stderr)
         return 1
     if args.json:
         print(json.dumps({
