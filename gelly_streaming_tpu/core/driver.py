@@ -85,7 +85,7 @@ def _frozen_delta(idx: np.ndarray, vals: np.ndarray) -> tuple:
 
 def _build_snapshot_scan(vb: int, analytics: tuple,
                          deltas: bool = False, egress: str = "full",
-                         cap: int = 0):
+                         cap: int = 0, donate: bool = False):
     """One jitted lax.scan over a [W, eb] window stack, carrying
     (degrees, cc labels, double-cover labels) and emitting PER-WINDOW
     snapshots — the driver's batched single-chip fast path (sharded
@@ -107,7 +107,15 @@ def _build_snapshot_scan(vb: int, analytics: tuple,
     2-3 orders of magnitude fewer d2h bytes on settled streams; the
     driver reconstructs full snapshots from its host mirrors, and a
     count exceeding `cap` routes the chunk to the bit-exact host fold.
-    The full masks are then NOT emitted (the wire subsumes them)."""
+    The full masks are then NOT emitted (the wire subsumes them).
+
+    With `donate` (the RESIDENT tier, ops/resident_engine), the carry
+    argument is donated where the backend honors donation — the
+    ResidentState slabs update in place across super-batches instead
+    of being re-allocated per dispatch — and under delta egress the
+    final cover row is emitted as an explicit FRESH output
+    (`cover_final`): the next super-batch donates the carry buffers,
+    so the drain must never alias them."""
     import jax
     import jax.numpy as jnp
 
@@ -176,6 +184,22 @@ def _build_snapshot_scan(vb: int, analytics: tuple,
             cover = new_cover
         return (deg, labels, cover), outs
 
+    if donate:
+        from ..ops import resident_engine
+
+        def run_fn(carry, s_w, d_w, valid_w):
+            new_carry, outs = jax.lax.scan(body, carry,
+                                           (s_w, d_w, valid_w))
+            if delta_out and want_bip:
+                # explicit copy primitive — a folded-away no-op like
+                # `+ 0` would leave this the same HLO value as the
+                # carry's cover, whose donated buffer super-batch N+1
+                # overwrites before chunk N's finalize reads it
+                outs["cover_final"] = jnp.copy(new_carry[2])
+            return new_carry, outs
+
+        return jax.jit(run_fn, **resident_engine.donate_kw())
+
     @jax.jit
     def run(carry, s_w, d_w, valid_w):
         return jax.lax.scan(body, carry, (s_w, d_w, valid_w))
@@ -193,10 +217,15 @@ def _reset_snapshot_tier() -> None:
 
 
 def resolve_snapshot_tier() -> str:
-    """Batched snapshot-analytics tier: the device scan by default; the
-    native C++ carried union-find (native.snapshot_windows) only when
-    (a) this process runs a CPU backend — on chip the scan always
-    stands — and (b) committed backend-matched `host_snapshot` rows
+    """Batched snapshot-analytics tier: the device scan by default;
+    the RESIDENT megakernel (ops/resident_engine) when the GS_RESIDENT
+    pin or committed backend-matched `resident_ab` rows select it —
+    that gate compares resident against the best committed alternative
+    (scan AND native), so adopting it can never regress a stream
+    native already serves faster; else the native C++ carried
+    union-find (native.snapshot_windows) only when (a) this process
+    runs a CPU backend — on chip the scan always stands — and (b)
+    committed backend-matched `host_snapshot` rows
     (tools/profile_kernels.py) all show parity and a ≥5% win, and
     (c) the library exports the symbol. The same measured-default
     policy as ops/triangles._resolve_stream_impl."""
@@ -205,9 +234,12 @@ def resolve_snapshot_tier() -> str:
         return _SNAPSHOT_TIER
     tier = "scan"
     try:
-        import jax as _jax
+        from ..ops import resident_engine
 
-        if _jax.default_backend() == "cpu":
+        if resident_engine.resolve_resident():
+            _SNAPSHOT_TIER = "resident"
+            return _SNAPSHOT_TIER
+        if _jax_backend() == "cpu":
             perf = tri_ops._load_matching_perf("cpu")
             if (tri_ops.rows_clear_bar(
                     (perf or {}).get("host_snapshot", []),
@@ -220,6 +252,12 @@ def resolve_snapshot_tier() -> str:
                         error="%s: %s" % (type(e).__name__, e))
     _SNAPSHOT_TIER = tier
     return tier
+
+
+def _jax_backend() -> str:
+    import jax as _jax
+
+    return _jax.default_backend()
 
 
 @dataclasses.dataclass
@@ -269,8 +307,14 @@ class StreamingAnalyticsDriver:
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
-        if snapshot_tier not in (None, "scan", "native", "host"):
+        if snapshot_tier not in (None, "resident", "scan", "native",
+                                 "host"):
             raise ValueError(f"unknown snapshot_tier: {snapshot_tier!r}")
+        if snapshot_tier == "resident" and mesh is not None:
+            raise ValueError(
+                "the resident tier is single-chip: a mesh session's "
+                "base tier is the sharded engine (its demotion ladder "
+                "re-enters on scan, never resident)")
         if snapshot_tier == "native" and not native.snapshot_available():
             raise ValueError("native snapshot tier pinned but "
                              "libgsnative lacks gs_snapshot_windows")
@@ -316,6 +360,12 @@ class StreamingAnalyticsDriver:
         # online dispatch tuner of the batched snapshot scan
         # (ops/autotune; built lazily, None with GS_AUTOTUNE=0)
         self._scan_tuner = None
+        # resident tier (ops/resident_engine): its own tuner family
+        # (windows-per-superbatch arms) and a per-call flag the scan
+        # program cache keys on (_scan_key) — the resident programs
+        # are a DISTINCT donated family
+        self._resident_tuner = None
+        self._resident_now = False
 
     def reset(self) -> None:
         """Clear all carried stream state (interner, analytics vectors,
@@ -362,6 +412,20 @@ class StreamingAnalyticsDriver:
             cap = self._scan_chunk()
             self._scan_tuner.rekey(
                 self._scan_tuner_key(),
+                space={"wb": sorted({max(1, cap // 4),
+                                     max(1, cap // 2), cap})},
+                initial={"wb": cap})
+        if (vb_grew or eb_grew) and self._resident_tuner is not None:
+            # same re-key-instead-of-discard contract for the resident
+            # tier's windows-per-superbatch tuner: the incumbent
+            # survives as the prior under the new bucket identity and
+            # the persisted cache re-seeds it — without this, bucket
+            # growth silently FROZE the resident arm at a dead key
+            # (the ISSUE-9 arm-freezing fix, pinned by
+            # tests/operations/test_resident.py)
+            cap = self._resident_chunk()
+            self._resident_tuner.rekey(
+                self._resident_tuner_key(),
                 space={"wb": sorted({max(1, cap // 4),
                                      max(1, cap // 2), cap})},
                 initial={"wb": cap})
@@ -627,6 +691,45 @@ class StreamingAnalyticsDriver:
         return ("snapshot_scan:eb=%d:vb=%d:%s"
                 % (self.eb, self.vb, "+".join(self.analytics)))
 
+    # ------------------------------------------------------------------
+    # resident tier (ops/resident_engine): windows-per-superbatch cap,
+    # its own tuner family, and the chunk cap the scan-cache helpers
+    # read — the donated program family dispatches super-batches of
+    # GS_RESIDENT_SPB windows instead of _SCAN_CHUNK chunks
+    # ------------------------------------------------------------------
+    def _resident_chunk(self) -> int:
+        from ..ops import resident_engine
+
+        return resident_engine.resident_spb(self.eb)
+
+    def _chunk_cap(self) -> int:
+        """Windows-per-dispatch cap of the ACTIVE program family:
+        the resident super-batch while the resident tier runs, the
+        compile-capped scan chunk otherwise."""
+        return (self._resident_chunk() if self._resident_now
+                else self._scan_chunk())
+
+    def _resident_tuner_key(self) -> str:
+        return ("resident_scan:eb=%d:vb=%d:%s"
+                % (self.eb, self.vb, "+".join(self.analytics)))
+
+    def _ensure_resident_tuner(self):
+        """The resident tier's windows-per-superbatch tuner
+        (ops/autotune): power-of-two rungs under the resident cap,
+        keyed as its own family so scan-tier rates never cross-seed
+        it. None when GS_AUTOTUNE=0 — the static super-batch stepping
+        then runs bit-identically."""
+        from ..ops import autotune
+
+        if not autotune.enabled():
+            return None
+        if getattr(self, "_resident_tuner", None) is None:
+            cap = self._resident_chunk()
+            wbs = sorted({max(1, cap // 4), max(1, cap // 2), cap})
+            self._resident_tuner = autotune.DispatchTuner(
+                self._resident_tuner_key(), {"wb": wbs}, {"wb": cap})
+        return self._resident_tuner
+
     def _ensure_scan_tuner(self):
         """The driver's online windows-per-dispatch tuner for the
         batched snapshot scan (ops/autotune): arms are power-of-two
@@ -685,7 +788,7 @@ class StreamingAnalyticsDriver:
         (tools/endurance_run.py's steady-state assert); right-sized
         programs still compile for callers whose FIRST batch is small
         (the per-window dispatch mode)."""
-        wb = seg_ops.bucket_size(min(num_w, self._scan_chunk()))
+        wb = seg_ops.bucket_size(min(num_w, self._chunk_cap()))
         key3 = self._scan_key()
         if getattr(self, "_scan_cache_key", None) != key3:
             self._scan_cache = {}
@@ -699,11 +802,14 @@ class StreamingAnalyticsDriver:
     def _scan_key(self):
         """Identity of the compiled snapshot-scan program family —
         bucket growth, analytics, the egress format (a delta program
-        emits a different out tree) AND mesh liveness (a demotion off
+        emits a different out tree), mesh liveness (a demotion off
         the sharded tier switches the family to the single-chip
-        programs; re-promotion switches back) invalidate the cache."""
+        programs; re-promotion switches back) AND the resident flag
+        (the resident tier's donated programs are a distinct family —
+        a demotion to scan must never dispatch a donating program)
+        invalidate the cache."""
         return (self.vb, self.eb, self.analytics, self._scan_egress(),
-                self._mesh_live())
+                self._mesh_live(), self._resident_now)
 
     def _scan_egress(self) -> str:
         """The batched scan's d2h egress format: the constructor pin,
@@ -721,6 +827,7 @@ class StreamingAnalyticsDriver:
         (vb, eb, analytics, egress, W-bucket) — O(log) programs
         total."""
         if wb not in self._scan_cache:
+            name = "snapshot_scan"
             if self._mesh_live():
                 from ..parallel.sharded import make_sharded_snapshot_scan
 
@@ -731,12 +838,14 @@ class StreamingAnalyticsDriver:
                 fn = _build_snapshot_scan(
                     self.vb, self.analytics, deltas=self.emit_deltas,
                     egress=self._scan_egress(),
-                    cap=delta_egress.egress_cap(self.eb, self.vb))
+                    cap=delta_egress.egress_cap(self.eb, self.vb),
+                    donate=self._resident_now)
+                if self._resident_now:
+                    name = "resident_scan"
             # compile watch (utils/metrics): every distinct abstract
             # signature this program family sees counts against the
             # O(log V) recompile envelope
-            self._scan_cache[wb] = metrics.wrap_jit("snapshot_scan",
-                                                    fn)
+            self._scan_cache[wb] = metrics.wrap_jit(name, fn)
         return self._scan_cache[wb]
 
     def _run_batched(self, windows,
@@ -876,7 +985,9 @@ class StreamingAnalyticsDriver:
         programming bug is never silently 'fixed' by falling off the
         fast tier.
 
-        The full ladder is sharded → single-chip scan → native → host:
+        The full ladder is sharded → resident → scan → native → host
+        (resident — the donated megakernel above scan — demotes TO
+        scan but is never itself a demotion target):
         a mesh session that loses a shard degrades to one device (the
         engine's gathered replicated state becomes the host mirrors —
         the same chunk-boundary sources the single-chip rungs re-enter
@@ -891,9 +1002,15 @@ class StreamingAnalyticsDriver:
             if not isinstance(cause, (RuntimeError, OSError,
                                       MemoryError)):
                 return False
-        order = ("sharded", "scan", "native", "host")
+        order = ("sharded", "resident", "scan", "native", "host")
         shard_id = getattr(cause, "shard", None)
         for nxt in order[order.index(tier) + 1:]:
+            if nxt == "resident":
+                # never a demotion TARGET: resident is the tier ABOVE
+                # scan — a device failure on any rung lands on the
+                # proven scan rung, not on a bigger donated program
+                # against the same ailing device
+                continue
             if nxt == "native" and not native.snapshot_available():
                 continue
             event = resilience.record_demotion(
@@ -1006,6 +1123,13 @@ class StreamingAnalyticsDriver:
         # host mirrors (populated by _absorb_engine_state) even though
         # its engine object still exists for the re-promotion path
         sharded = tier == "sharded"
+        # resident tier (ops/resident_engine): the device-scan branch
+        # with the donated super-batch program family, prep+h2d on the
+        # ingest ring, and one dispatch per GS_RESIDENT_SPB windows.
+        # The flag keys the program cache (_scan_key), so a demotion
+        # to scan mid-call switches families cleanly at re-entry.
+        resident = tier == "resident"
+        self._resident_now = resident
         # native/host tiers of the snapshot stage: carried union-find
         # + degree fold (C++ or numpy — bit-exact twins) producing the
         # SAME per-window `outs`
@@ -1044,7 +1168,7 @@ class StreamingAnalyticsDriver:
                      jnp.asarray(cov0))
 
         num_w = len(interned)
-        scan_chunk = self._scan_chunk()
+        scan_chunk = self._chunk_cap()
         # Depth-2 pipeline over the DEVICE scan branch: the scan carry
         # is a device array, so chunk i+1's dispatch needs only the
         # un-materialized carry — chunk i's d2h + extraction + chunk-
@@ -1172,13 +1296,13 @@ class StreamingAnalyticsDriver:
                     self._bip = outs["cover"][last][:2 * vb].copy()
             _boundary(at, chunk)
 
-        pending = None  # (at, chunk, device outs)
+        pending = None  # (at, chunk, device outs, superbatch stopwatch)
 
         def finalize_pending():
             nonlocal pending
             if pending is None:
                 return
-            f_at, f_chunk, f_outs = pending
+            f_at, f_chunk, f_outs, f_sw = pending
             pending = None
             with self._step("snapshot_wait",
                             sum(len(s) for _w, s, _d, _n in f_chunk)):
@@ -1193,22 +1317,44 @@ class StreamingAnalyticsDriver:
                 f_outs = resilience.call_guarded(
                     "finalize", f_at, _mat, retries=0)
             _finalize_chunk(f_at, f_chunk, f_outs)
+            if f_sw is not None:
+                # the resident super-batch span closes at its DRAIN —
+                # dispatch through materialize + extraction (the
+                # owner-rule mark_window already fired in _boundary)
+                f_sw.stop(windows=len(f_chunk))
 
         # prep stage of the device-scan branch: the [wb, eb] stack
         # build for chunk i+1 runs on the ingress prep pool while
-        # chunk i executes on device (single lookahead — the scan
-        # carry forces dispatches sequential, so only prep pipelines).
-        # The W-bucket is chosen on the MAIN thread at submit time
-        # (item = (chunk, wb)): _scan_wb reads/mutates the jit cache,
-        # which a pool worker must never touch concurrently with
-        # _scan_fn_at's insertions.
+        # chunk i executes on device (the scan carry forces dispatches
+        # sequential, so only prep — and, on the resident tier, h2d —
+        # pipelines). The scan tier keeps its single lookahead; the
+        # RESIDENT tier runs a GS_RESIDENT_SLOTS ingest ring whose
+        # worker task is prep + h2d of a whole super-batch, so slot
+        # N+1 transfers while super-batch N computes. The W-bucket is
+        # chosen on the MAIN thread at submit time (item = (chunk,
+        # wb)): _scan_wb reads/mutates the jit cache, which a pool
+        # worker must never touch concurrently with _scan_fn_at's
+        # insertions.
         def _build_stack(item):
             chunk, wb = item
             s_w, d_w, valid = seg_ops.stack_window_rows(
                 [(s, d) for _w, s, d, _n in chunk], wb, self.eb, vb)
             return wb, s_w, d_w, valid
 
-        prefetched = None  # (at, future, item) for next chunk's stacks
+        def _build_dev(item):
+            # resident ring task: prep + h2d on the worker
+            # (jnp.asarray is thread-safe — the run_pipeline h2d
+            # contract), so the main thread's only steady-state job is
+            # dispatching and draining
+            wb, s_w, d_w, valid = _build_stack(item)
+            return (wb, jnp.asarray(s_w), jnp.asarray(d_w),
+                    jnp.asarray(valid))
+
+        build_job = _build_dev if resident else _build_stack
+        from ..ops import resident_engine
+
+        ring = resident_engine.IngestRing(
+            slots=resident_engine.ring_slots() if resident else 1)
         fold = (native.snapshot_windows if tier == "native"
                 else host_snapshot.snapshot_windows)
 
@@ -1217,8 +1363,11 @@ class StreamingAnalyticsDriver:
         # count) is decided — and its W-bucket program warmed — at the
         # chunk's PREP-submit point, so exploration never compiles
         # mid-measurement. GS_AUTOTUNE=0 (or the native/host/sharded
-        # branches) keeps the static scan_chunk stepping bit-identically.
-        tuner = (self._ensure_scan_tuner()
+        # branches) keeps the static scan_chunk stepping
+        # bit-identically. The resident tier tunes its own family —
+        # windows-per-superbatch rungs under the resident cap.
+        tuner = ((self._ensure_resident_tuner() if resident
+                  else self._ensure_scan_tuner())
                  if run_scan and not sharded and native_state is None
                  else None)
         decided = {}  # chunk start -> (take, arm)
@@ -1255,8 +1404,10 @@ class StreamingAnalyticsDriver:
                     tuner.record(arm, edges, sw.stop(edges=edges))
             meas = None
 
+        next_submit = 0  # first window position not yet on the ring
+
         def _chunk_loop():
-          nonlocal carry, native_state, pending, prefetched, meas
+          nonlocal carry, native_state, pending, meas, next_submit
           at = 0
           while at < num_w:
             take, cur_arm = _decide(at)
@@ -1288,29 +1439,33 @@ class StreamingAnalyticsDriver:
                 if prevs is not None:
                     self._host_mask_outs(outs, prevs)
             elif run_scan:
-                if prefetched is not None and prefetched[0] == at:
+                got = ring.pop(at)
+                if got is not None:
+                    fut, item = got
                     timeout = resilience.stage_timeout_s()
                     try:
-                        wb, s_w, d_w, valid = prefetched[1].result(
+                        wb, s_w, d_w, valid = fut.result(
                             timeout=2 * timeout if timeout > 0
                             else None)
                     except BaseException as e:
                         # interrupts and the simulated hard kill pass
                         # through; any other failure (a hung worker's
                         # _FutureTimeout, a transient PrepError) gets
-                        # the guard's retry budget — prep is pure, so
-                        # the inline rebuild is always safe
+                        # the guard's retry budget — prep (and the
+                        # resident ring's h2d) is pure, so the inline
+                        # rebuild is always safe
                         if (not isinstance(e, Exception)
                                 or ingress_pipeline._is_fatal(e)
                                 or not resilience.guard_active()):
                             raise  # inert knobs keep legacy fail-fast
                         wb, s_w, d_w, valid = resilience.call_guarded(
                             "prep", at,
-                            lambda: _build_stack(prefetched[2]))
+                            lambda: build_job(item))
                 else:
-                    wb, s_w, d_w, valid = _build_stack(
+                    wb, s_w, d_w, valid = build_job(
                         (chunk, self._scan_wb(len(chunk))))
-                prefetched = None
+                if next_submit <= at:
+                    next_submit = at + take
                 fn = self._scan_fn_at(wb)
                 # close the previous chunk's measurement BEFORE the
                 # next arm's decide: an exploration arm's warm-up
@@ -1318,20 +1473,21 @@ class StreamingAnalyticsDriver:
                 # _warm_scan_arm) must never bleed into the
                 # incumbent's recorded interval
                 _meas_flush()
-                # submit the NEXT chunk's prep only after this chunk's
+                # top the ingest ring up only after this chunk's
                 # program is in the cache, so the ragged final chunk's
                 # bigger-bucket reuse sees it (no tail compile) and
-                # the worker itself never touches the cache
-                nxt = at + take
-                if nxt < num_w:
-                    nxt_take, _ = _decide(nxt)
-                    nxt_chunk = interned[nxt:nxt + nxt_take]
-                    nxt_item = (nxt_chunk,
-                                self._scan_wb(len(nxt_chunk)))
-                    fut = ingress_pipeline.submit_prep(
-                        _build_stack, nxt_item)
-                    if fut is not None:
-                        prefetched = (nxt, fut, nxt_item)
+                # the worker itself never touches the cache. The scan
+                # tier's ring is one slot (the legacy single
+                # lookahead); the resident ring keeps GS_RESIDENT_SLOTS
+                # super-batches prepped+transferred ahead.
+                while next_submit < num_w and not ring.full:
+                    s_take, _ = _decide(next_submit)
+                    s_chunk = interned[next_submit:
+                                       next_submit + s_take]
+                    s_item = (s_chunk, self._scan_wb(len(s_chunk)))
+                    if not ring.submit(build_job, next_submit, s_item):
+                        break  # pipelining disabled: build inline
+                    next_submit += s_take
                 # one measurement round per chunk (the dispatch-to-
                 # dispatch interval is the pipelined steady state's
                 # per-chunk wall time). Recorded only when the chunk
@@ -1346,17 +1502,26 @@ class StreamingAnalyticsDriver:
                             telemetry.stopwatch("driver.scan_round",
                                                 window=at,
                                                 wb=cur_arm["wb"]))
+                sw = (telemetry.stopwatch(
+                          "resident.superbatch", window=at, wb=take,
+                          edges=sum(len(s)
+                                    for _w, s, _d, _n in chunk))
+                      if resident else None)
                 with self._step("snapshot_scan",
                                 sum(len(s) for _w, s, _d, _n in chunk)):
                     # async dispatch: returns device arrays without
                     # blocking; the d2h lands in this chunk's finalize
                     # (snapshot_wait), AFTER the next chunk is queued.
-                    # Guarded WITH retries: the jitted scan is pure
-                    # (carry in, new carry out — rebound only on
-                    # success), so re-dispatching a failed chunk is
-                    # safe; exhausted retries surface as typed
-                    # StageFailed/StageTimeout and feed the demotion
-                    # ladder in _run_batched.
+                    # Guarded WITH retries on the scan tier: the
+                    # jitted scan is pure (carry in, new carry out —
+                    # rebound only on success), so re-dispatching a
+                    # failed chunk is safe. The RESIDENT tier is
+                    # deadline-only (retries=0): its program DONATES
+                    # the carry buffers, so a failed attempt may
+                    # already have consumed them — the failure demotes
+                    # to scan and _run_batched re-enters from the
+                    # mirrors instead. Exhausted budgets surface as
+                    # typed StageFailed/StageTimeout either way.
                     def _disp(s_w=s_w, d_w=d_w, valid=valid,
                               carry_in=carry):
                         faults.fire("dispatch")
@@ -1377,15 +1542,20 @@ class StreamingAnalyticsDriver:
 
                     carry, outs = resilience.call_guarded(
                         "dispatch", at, _disp,
-                        retries=resilience.stage_retries())
-                    if "cover_cnt" in outs:
+                        retries=(0 if resident
+                                 else resilience.stage_retries()))
+                    if "cover_cnt" in outs \
+                            and "cover_final" not in outs:
                         # delta egress ships odd-flag deltas, which
                         # cannot resync the cover-label mirror; the
                         # chunk's final cover IS the carry — one
-                        # [2vb+1] d2h per chunk instead of [W, 2vb]
+                        # [2vb+1] d2h per chunk instead of [W, 2vb].
+                        # (The donated resident program already emits
+                        # a fresh cover_final — aliasing the donated
+                        # carry here would read a consumed buffer.)
                         outs["cover_final"] = carry[2]
                 finalize_pending()
-                pending = (at, chunk, outs)
+                pending = (at, chunk, outs, sw)
                 at += take
                 continue
             # only the device-scan branch (which `continue`s above)
@@ -1403,6 +1573,7 @@ class StreamingAnalyticsDriver:
             # best-effort — mirrors/cursors then sit at the last chunk
             # the device actually completed, which is what makes the
             # demotion re-entry (and an operator resume) exact
+            ring.drain()
             try:
                 finalize_pending()
             except Exception as drain_err:
@@ -2032,6 +2203,11 @@ class StreamingAnalyticsDriver:
             # the learned dispatch configuration rides the checkpoint
             # so a resumed stream keeps its optimum (ops/autotune)
             state["autotune"] = self._scan_tuner.state_dict()
+        if getattr(self, "_resident_tuner", None) is not None:
+            # the resident tier's windows-per-superbatch tuner rides
+            # beside it under its own key (distinct arm space)
+            state["autotune_resident"] = \
+                self._resident_tuner.state_dict()
         return state
 
     def load_state_dict(self, state: dict) -> None:
@@ -2100,6 +2276,11 @@ class StreamingAnalyticsDriver:
             tuner = self._ensure_scan_tuner()
             if tuner is not None:
                 tuner.load_state_dict(state["autotune"])
+        if state.get("autotune_resident") is not None \
+                and self.mesh is None:
+            tuner = self._ensure_resident_tuner()
+            if tuner is not None:
+                tuner.load_state_dict(state["autotune_resident"])
 
     def trace_report(self) -> List[dict]:
         return self.timer.report() if self.timer else []

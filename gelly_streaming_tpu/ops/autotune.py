@@ -354,6 +354,6 @@ class DispatchTuner:
             self.incumbent = {k: inc[k] for k in self.space}
         self._ema = {str(k): float(v)
                      for k, v in state.get("ema", [])}
-        self._round = int(state.get("round", 0))
-        self._promotions = int(state.get("promotions", 0))
-        self._explore_cursor = int(state.get("explore_cursor", 0))
+        self._round = int(state.get("round", 0))  # gslint: disable=host-sync (checkpoint payloads are host scalars, never device values)
+        self._promotions = int(state.get("promotions", 0))  # gslint: disable=host-sync (checkpoint payloads are host scalars, never device values)
+        self._explore_cursor = int(state.get("explore_cursor", 0))  # gslint: disable=host-sync (checkpoint payloads are host scalars, never device values)

@@ -52,8 +52,8 @@ def validate_ids(src: np.ndarray, dst: np.ndarray, bound: int,
     65536 still rejects the one unrepresentable id loudly."""
     if len(src) == 0 and len(dst) == 0:
         return
-    top = int(max(src.max(), dst.max()))
-    bot = int(min(src.min(), dst.min()))
+    top = int(max(src.max(), dst.max()))  # gslint: disable=host-sync (host-input wrap-safety check: callers pass numpy, never device values)
+    bot = int(min(src.min(), dst.min()))  # gslint: disable=host-sync (host-input wrap-safety check: callers pass numpy, never device values)
     limit = min(bound, MAX_U16_VB)
     if bot < 0 or top >= limit:
         raise ValueError(
@@ -120,8 +120,8 @@ def stack_window_list(windows, eb: int):
         if k > eb:
             raise ValueError(f"window of {k} edges exceeds edge "
                              f"bucket {eb}")
-        s16[w, :k] = np.asarray(ws, np.uint16)
-        d16[w, :k] = np.asarray(wd, np.uint16)
+        s16[w, :k] = np.asarray(ws, np.uint16)  # gslint: disable=host-sync (host-side wire-format pack: inputs are host window lists)
+        d16[w, :k] = np.asarray(wd, np.uint16)  # gslint: disable=host-sync (host-side wire-format pack: inputs are host window lists)
         nvalid[w] = k
     return s16, d16, nvalid
 

@@ -459,7 +459,8 @@ def _future_wait(fut, t: Optional[float]) -> bool:
 
 def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
                  dispatch: Callable, finalize: Callable,
-                 timers: Optional[StageTimers] = None) -> None:
+                 timers: Optional[StageTimers] = None,
+                 inflight: Optional[int] = None) -> None:
     """Run `items` (ordered chunk descriptors) through the three
     stages. Contracts:
 
@@ -488,6 +489,11 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
     d2h in flight are never silently abandoned. With pipelining
     disabled (`forced_sync`, GS_STREAM_PREFETCH=0, or zero workers)
     both stages run inline — identical results either way.
+
+    `inflight` narrows the prepped+transferred look-ahead below the
+    global GS_PIPELINE_INFLIGHT for callers with their own ring
+    contract (the resident tier's GS_RESIDENT_SLOTS ingest ring);
+    None keeps the global bound.
     """
     items = list(items)
     pool = prep_pool() if len(items) > 1 else None
@@ -575,7 +581,8 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
             # (default 3) — the footprint bound of the old depth-2
             # queue, independent of the pool width
             lookahead = min(len(items), worker_count() + 1,
-                            inflight_limit())
+                            min(inflight, inflight_limit())
+                            if inflight else inflight_limit())
             futures = deque(_submit(it) for it in items[:lookahead])
             nxt = lookahead
             while futures:

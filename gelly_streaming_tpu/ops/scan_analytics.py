@@ -110,6 +110,14 @@ class SummaryEngineBase:
     # mid-measurement
     AUTOTUNE = False
     TUNABLE_INGRESS = False
+    # cache-identity prefix of the dispatch tuner (ops/autotune): the
+    # resident engine re-keys its own family so its learned
+    # windows-per-superbatch never cross-seeds the scan tier's
+    TUNER_FAMILY = "fused_scan"
+    # max prepped+transferred chunks in flight ahead of dispatch; None
+    # = the global GS_PIPELINE_INFLIGHT. The resident engine narrows
+    # it to its GS_RESIDENT_SLOTS ingest ring.
+    INGEST_SLOTS = None
 
     def reset(self) -> None:
         self._closed_partial = False
@@ -441,7 +449,7 @@ class SummaryEngineBase:
 
         ingress_pipeline.run_pipeline(
             range(at0, hi_w, wb), prep, h2d, dispatch, finalize,
-            timers=self.stage_timers)
+            timers=self.stage_timers, inflight=self.INGEST_SLOTS)
 
     def _build_stack(self, src, dst, fmt: str):
         """Whole-stream window stack in wire format `fmt` (compact
@@ -486,7 +494,8 @@ class SummaryEngineBase:
                     "ingress": (self.ingress if self.ingress in ing
                                 else "standard")}
             self._tuner = autotune.DispatchTuner(
-                "fused_scan:eb=%d:vb=%d" % (self.eb, self.vb),
+                "%s:eb=%d:vb=%d" % (self.TUNER_FAMILY, self.eb,
+                                    self.vb),
                 {"wb": wbs, "ingress": ing}, init)
         return self._tuner
 

@@ -244,6 +244,23 @@ register("GS_TUNE_CACHE", "path", None,
               "this run's optimum; `0` disables persistence",
          default_text="`~/.cache/gelly_streaming_tpu`")
 
+# resident-state tier (ops/resident_engine.py)
+register("GS_RESIDENT", "str", "", choices=("on", "off", "auto"),
+         help="pin the resident-state snapshot tier "
+              "(`ops/resident_engine.py`): `on` forces it, `off` "
+              "never selects it; unset/`auto` = adopt only on "
+              "committed parity+≥5% `resident_ab` rows over the best "
+              "committed alternative tier",
+         default_text="auto")
+register("GS_RESIDENT_SPB", "int", 256, lo=1,
+         help="windows per super-batch of the resident megakernel "
+              "(one donated dispatch folds this many windows; "
+              "compile-size-capped per program on TPU backends)")
+register("GS_RESIDENT_SLOTS", "int", 2, lo=1,
+         help="ingest-ring depth of the resident tier: super-batches "
+              "prepped+transferred ahead of dispatch (2 = the "
+              "double-buffered form — slot N+1 fills while N computes)")
+
 # egress (ops/delta_egress.py)
 register("GS_EGRESS", "str", "", choices=("full", "delta", "auto"),
          help="pin the batched d2h egress: `full` (whole snapshot "

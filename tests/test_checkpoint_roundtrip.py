@@ -21,8 +21,8 @@ from gelly_streaming_tpu.utils.disjoint_set import DisjointSet
 
 pytestmark = pytest.mark.faults
 
-TIERS = ["scan", "host"] + (["native"] if native.snapshot_available()
-                            else [])
+TIERS = ["resident", "scan", "host"] + (
+    ["native"] if native.snapshot_available() else [])
 
 
 def _stream(n=4096, v=384, seed=9):
@@ -122,6 +122,55 @@ def test_summary_engine_auto_checkpoint_resume(tmp_path):
     # positional at-least-once combine: keep the delivered prefix up
     # to the resume cursor, then the resumed suffix
     assert head[:off // eb] + tail == full
+
+
+def test_resident_engine_cross_tier_resume(tmp_path):
+    """A ResidentSummaryEngine checkpoint (device-resident donated
+    carry, gathered at the super-batch boundary) resumes bit-exactly
+    on (a) a fresh resident engine, (b) the scan-tier
+    StreamSummaryEngine, and (c) the numpy HostSummaryEngine — the
+    resident → resident / resident → scan / resident → host-twin legs
+    of the ISSUE-9 acceptance bar (the carry layout is shared by
+    construction, DESIGN.md §15)."""
+    from gelly_streaming_tpu.ops.resident_engine import (
+        ResidentSummaryEngine)
+    from gelly_streaming_tpu.parallel.host_twin import HostSummaryEngine
+
+    src, dst = _stream(n=2048, v=200)
+    src32, dst32 = src.astype(np.int32), dst.astype(np.int32)
+    eb, vb = 256, 256
+    full = ResidentSummaryEngine(
+        edge_bucket=eb, vertex_bucket=vb).process(src32, dst32)
+    # the resident engine equals the scan engine window-for-window
+    assert full == StreamSummaryEngine(
+        edge_bucket=eb, vertex_bucket=vb).process(src32, dst32)
+
+    path = str(tmp_path / "res.npz")
+    a = ResidentSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    head = a.process(src32[:4 * eb], dst32[:4 * eb])
+    ck.save(path, a.state_dict())
+    del a  # the kill
+
+    for make in (lambda: ResidentSummaryEngine(edge_bucket=eb,
+                                               vertex_bucket=vb),
+                 lambda: StreamSummaryEngine(edge_bucket=eb,
+                                             vertex_bucket=vb),
+                 lambda: HostSummaryEngine(edge_bucket=eb,
+                                           vertex_bucket=vb)):
+        b = make()
+        assert b.try_resume(path)
+        off = b.resume_offset()
+        tail = b.process(src32[off:], dst32[off:])
+        assert head + tail == full, type(b).__name__
+
+    # and the reverse leg: a SCAN-tier checkpoint resumes on resident
+    c = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    c.process(src32[:4 * eb], dst32[:4 * eb])
+    ck.save(path, c.state_dict())
+    d = ResidentSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    assert d.try_resume(path)
+    off = d.resume_offset()
+    assert head + d.process(src32[off:], dst32[off:]) == full
 
 
 def test_sharded_engine_state_roundtrip_through_file(tmp_path):

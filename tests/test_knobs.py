@@ -18,7 +18,9 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_STAGE_TIMEOUT_S GS_STAGE_RETRIES GS_STAGE_BACKOFF_S "
        "GS_TIER_RETRY_WINDOWS GS_TIER_DEMOTE GS_MESH_DEMOTE "
        "GS_MESH_WIRE_CHECK GS_AUTOTUNE GS_AUTOTUNE_ROUND "
-       "GS_AUTOTUNE_EXPLORE GS_TUNE_CACHE GS_EGRESS GS_EGRESS_CAP "
+       "GS_AUTOTUNE_EXPLORE GS_TUNE_CACHE "
+       "GS_RESIDENT GS_RESIDENT_SPB GS_RESIDENT_SLOTS "
+       "GS_EGRESS GS_EGRESS_CAP "
        "GS_TELEMETRY GS_TRACE_DIR GS_TRACE_RING "
        "GS_TRACE_DURABLE GS_METRICS GS_METRICS_PORT "
        "GS_METRICS_SERIES GS_METRICS_COMPILE_BASE "

@@ -52,6 +52,7 @@ RATE_FIELDS = (
     "pipelined_edges_per_s", "sync_edges_per_s", "std_edges_per_s",
     "compact_edges_per_s", "full_edges_per_s", "delta_edges_per_s",
     "armed_edges_per_s", "disarmed_edges_per_s", "edges_per_s",
+    "resident_edges_per_s", "perwindow_edges_per_s",
 )
 RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline")
 
@@ -64,6 +65,7 @@ PERF_SECTIONS = {
     "pipeline_stages": ("engine", "edge_bucket"),
     "ingress_ab": ("probe",),
     "egress_ab": ("probe",),
+    "resident_ab": ("probe",),
     "autotune": ("engine", "edge_bucket"),
 }
 

@@ -25,6 +25,12 @@ Schedule (all deterministic, utils/faults — no randomness anywhere):
             · 1 fatal kill mid-call → fresh engine resumes from its
               auto-checkpoint, positional combine
 
+  leg R — the RESIDENT drill: the driver pinned to the resident
+          megakernel (ops/resident_engine), fatal kill MID-SUPERBATCH
+          → auto-checkpoint resume → window-by-window sha256 parity
+          with the fault-free SCAN-tier oracle (cross-tier: the
+          donated carry never leaks a half-applied super-batch)
+
   leg M — the MESH drill (virtual n-device CPU mesh, armed via
           --mesh-devices; the process pins a CPU backend with that
           many virtual devices before jax initializes): a sharded
@@ -282,6 +288,78 @@ def leg_autotune(path: str, eb: int, num_w: int, workdir: str) -> dict:
             "resumed_from_window": resumed_from,
             "tuner_rounds_at_resume": int(restored.get("round", 0)),
             "tuner_incumbent": restored.get("incumbent"),
+            "parity": True,
+        }
+    finally:
+        for k, v in env_prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def leg_resident(path: str, eb: int, num_w: int, workdir: str) -> dict:
+    """The resident-tier leg: the driver pinned to the RESIDENT
+    megakernel (ops/resident_engine — donated super-batch programs +
+    the ingest ring), killed by a fatal injected fault MID-SUPERBATCH
+    and resumed from its auto-checkpoint — the final window-by-window
+    sha256 digests must equal the fault-free SCAN-tier oracle, so the
+    donated carry provably never leaks a half-applied super-batch into
+    delivered results (checkpoints are gathered at super-batch
+    boundaries only). Runs with a 30 s stage deadline like the
+    autotune leg: this leg proves the kill→resume parity of the
+    resident tier, not the watchdog (leg A owns that), and the chaos
+    1 s deadline would demote the megakernel under host load."""
+    env_prev = {k: os.environ.get(k) for k in ("GS_STAGE_TIMEOUT_S",)}
+    os.environ["GS_STAGE_TIMEOUT_S"] = "30"
+    piece = 1 << 20
+
+    def make(tier):
+        return StreamingAnalyticsDriver(
+            window_ms=0, edge_bucket=eb, vertex_bucket=1024,
+            analytics=("degrees", "cc", "bipartite", "triangles"),
+            snapshot_tier=tier)
+
+    try:
+        # the ORACLE is the scan tier: cross-tier parity is the claim
+        baseline = [
+            _digest(r)
+            for r in make("scan").stream_file(path, chunk_bytes=piece)]
+        assert len(baseline) == num_w, (len(baseline), num_w)
+
+        ckpt = os.path.join(workdir, "resident.npz")
+        drv = make("resident")
+        drv.enable_auto_checkpoint(ckpt, every_n_windows=4)
+        got = {}
+        killed = False
+        fired = []
+        try:
+            with faults.inject(faults.FaultSpec(
+                    site="dispatch", on_call=3, fatal=True)) as plan:
+                for r in drv.stream_file(path, chunk_bytes=piece):
+                    got[_digest(r)[0]] = _digest(r)
+        except faults.InjectedFault:
+            killed = True
+            fired = list(plan.fired)
+        if not killed:
+            raise SystemExit("chaos resident leg: the kill never "
+                             "fired (fired=%r)" % (plan.fired,))
+
+        drv2 = make("resident")
+        if not drv2.try_resume(ckpt):
+            drv2 = make("resident")  # killed before the first flush
+        resumed_from = drv2.windows_done
+        for r in drv2.stream_file(path, chunk_bytes=piece,
+                                  resume=resumed_from > 0):
+            got[_digest(r)[0]] = _digest(r)
+        final = [got[k] for k in sorted(got)]
+        if final != baseline:
+            raise SystemExit("chaos resident leg DIVERGED from the "
+                             "fault-free scan-tier oracle")
+        return {
+            "windows": num_w,
+            "resumed_from_window": resumed_from,
+            "faults_fired": [list(f) for f in fired],
             "parity": True,
         }
     finally:
@@ -675,6 +753,10 @@ def main():
             # autotune leg: scan tier + live tuner, kill → resume,
             # tuning state must round-trip the checkpoint bit-for-bit
             at = leg_autotune(path, args.eb, num_w, workdir)
+            # resident leg: the donated megakernel killed
+            # mid-superbatch → resume → sha256 window parity with the
+            # fault-free SCAN-tier oracle
+            rs = leg_resident(path, args.eb, num_w, workdir)
             # leg B runs a right-sized twin stream: the fused scan's
             # CPU cold-compile + materialize must FIT the 1 s chaos
             # deadline (at vb=65536 the first chunk's finalize
@@ -695,9 +777,9 @@ def main():
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
                           args.mesh_devices, workdir)
                  if args.mesh_devices else None)
-            # flight-recorder leg: three kills fired above (driver,
-            # autotune, engine) — the ledger must prove all of them
-            fr = assert_flight_recorder(num_kills=3)
+            # flight-recorder leg: four kills fired above (driver,
+            # autotune, resident, engine) — the ledger must prove all
+            fr = assert_flight_recorder(num_kills=4)
             fr["span_summary"] = telemetry.summary(top=12)
         finally:
             telemetry.reset()  # close the ledger inside the tempdir
@@ -717,6 +799,10 @@ def main():
             elif action == "raise":
                 classes.add("kill_resume")
     required = {"prep_failure", "h2d_timeout_retry", "kill_resume"}
+    for site, _n, action in rs["faults_fired"]:
+        if site == "dispatch" and action == "raise":
+            classes.add("resident_kill_resume")
+    required.add("resident_kill_resume")
     if m is not None:
         for site, _n, action in m["faults_fired"]:
             if action == "corrupt_shard":
@@ -741,6 +827,7 @@ def main():
         "vertices": args.vertices,
         "knobs": KNOBS,
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
+        "resident_leg": rs,
         "health_leg": h,
         "mesh_leg": m,
         "flight_recorder_leg": fr,

@@ -36,6 +36,7 @@ LIST_SECTIONS = {
     "host_snapshot": ("edge_bucket", "parity"),
     "ingress_ab": ("probe", "parity"),
     "egress_ab": ("probe", "parity"),
+    "resident_ab": ("probe", "parity"),
     "autotune": ("engine", "parity"),
     "pipeline_stages": ("engine", "edge_bucket"),
     "chunk_deep": ("edge_bucket",),
@@ -69,7 +70,7 @@ DICT_SECTIONS = {
 
 # A/B sections whose parity-true rows must claim a positive speedup
 # (the adoption gates divide by it; rows_clear_bar rejects otherwise)
-_AB_SECTIONS = ("ingress_ab", "egress_ab")
+_AB_SECTIONS = ("ingress_ab", "egress_ab", "resident_ab")
 
 
 def _check_rows(name: str, rows, errors) -> None:

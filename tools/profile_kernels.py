@@ -1035,6 +1035,25 @@ def section_egress_ab(results: dict) -> None:
     results["egress_ab"] = rows
 
 
+def section_resident_ab(results: dict) -> None:
+    """Resident-tier A/B (ops/resident_engine.py) — the committed
+    evidence `resolve_resident` reads, via the same probes as the
+    standalone tools/resident_ab.py: the donated super-batch
+    megakernel vs chunked scan vs per-window scan dispatch (driver
+    and summary engine), exact parity asserted, median-of-3 with
+    dispersion. GS_AUTOTUNE is already pinned off for this child, so
+    the residency lever is measured in isolation."""
+    import jax
+
+    from tools.resident_ab import driver_resident, engine_resident
+
+    rows = []
+    edges = int(os.environ.get("GS_AB_EDGES", 524_288))
+    driver_resident(jax, edges, rows)
+    engine_resident(jax, edges, rows)
+    results["resident_ab"] = rows
+
+
 def section_autotune(results: dict) -> None:
     """Online dispatch-tuner evidence (ops/autotune.py): the triangle
     stream's device path static vs tuned-from-cold vs tuned-seeded
@@ -1498,6 +1517,10 @@ SECTIONS = {
     "dense": section_dense,
     "roofline": section_roofline,
     "trace": section_trace,
+    # resident_ab compiles snapshot-scan-family programs (the donated
+    # super-batch form): wedge-prone on the tunneled chip, so it runs
+    # with the other scan-class compiles at the END of the order
+    "resident_ab": section_resident_ab,
     "fused": section_fused,
     "driver": section_driver,
 }
