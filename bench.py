@@ -505,6 +505,11 @@ def run_at_scale(scale: float, metric_suffix: str = "") -> None:
     # with its trace ID and the top span aggregates riding along
     from gelly_streaming_tpu.utils import telemetry as _telemetry
 
+    # the run trace ID rides EVERY row (armed or not — the recorder
+    # mints one per process regardless), so a bench_compare regression
+    # against this row correlates straight to its ledger
+    # (tools/explain_perf.py --regression)
+    row["trace"] = _telemetry.trace_id()
     if _telemetry.enabled():
         row["telemetry"] = {"armed": True,
                             "trace": _telemetry.trace_id(),
@@ -584,6 +589,8 @@ def run_reduce_leg(metric_suffix: str = "") -> None:
         _resolve_reduce_impl)
 
     tier = _resolve_reduce_impl("sum")
+    from gelly_streaming_tpu.utils import telemetry as _telemetry
+
     device_path_rate = None
     if tier != "device":
         # decomposition row: the raw device segment-kernel path (one
@@ -612,6 +619,8 @@ def run_reduce_leg(metric_suffix: str = "") -> None:
         "baseline_cpu_with_counts_edges_per_s": round(cpu_rate_counts),
         "vs_baseline_with_counts": round(rate / cpu_rate_counts, 2),
         "num_edges": num_edges,
+        # trace-ID correlation (see the triangles leg's row)
+        "trace": _telemetry.trace_id(),
         **({"device_path_edges_per_s": round(device_path_rate),
             "device_path_vs_baseline": round(
                 device_path_rate / cpu_rate, 2)}

@@ -1507,8 +1507,10 @@ class StreamingAnalyticsDriver:
                           edges=sum(len(s)
                                     for _w, s, _d, _n in chunk))
                       if resident else None)
+                telemetry.pop_dispatch_tags()  # drop stale warm-up tags
                 with self._step("snapshot_scan",
-                                sum(len(s) for _w, s, _d, _n in chunk)):
+                                sum(len(s) for _w, s, _d, _n in chunk)) \
+                        as scan_sp:
                     # async dispatch: returns device arrays without
                     # blocking; the d2h lands in this chunk's finalize
                     # (snapshot_wait), AFTER the next chunk is queued.
@@ -1522,6 +1524,14 @@ class StreamingAnalyticsDriver:
                     # to scan and _run_batched re-enters from the
                     # mirrors instead. Exhausted budgets surface as
                     # typed StageFailed/StageTimeout either way.
+                    # the wrapped scan program binds its program/sig
+                    # tags (utils/costmodel) in the TLS of whichever
+                    # thread runs the dispatch — the watchdog helper
+                    # when GS_STAGE_TIMEOUT_S is armed — so they are
+                    # captured inside _disp and carried back through
+                    # the closure onto the step span
+                    disp_tags = {}
+
                     def _disp(s_w=s_w, d_w=d_w, valid=valid,
                               carry_in=carry):
                         faults.fire("dispatch")
@@ -1537,13 +1547,17 @@ class StreamingAnalyticsDriver:
                             s_w, d_w = _sh.guard_wire(
                                 (s_w, d_w), nsh, self.vb + 1)
                             _sh.fire_shard_dispatch(nsh)
-                        return fn(carry_in, jnp.asarray(s_w),
-                                  jnp.asarray(d_w), jnp.asarray(valid))
+                        out = fn(carry_in, jnp.asarray(s_w),
+                                 jnp.asarray(d_w), jnp.asarray(valid))
+                        disp_tags.update(telemetry.pop_dispatch_tags())
+                        return out
 
                     carry, outs = resilience.call_guarded(
                         "dispatch", at, _disp,
                         retries=(0 if resident
                                  else resilience.stage_retries()))
+                    if scan_sp is not None:
+                        scan_sp.attrs.update(disp_tags)
                     if "cover_cnt" in outs \
                             and "cover_final" not in outs:
                         # delta egress ships odd-flag deltas, which

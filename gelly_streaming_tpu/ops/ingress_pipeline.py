@@ -534,11 +534,22 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
     def _consume(item, dev, tctx=None):
         nonlocal pending
 
+        # the wrapped program called inside dispatch() binds its
+        # program/signature tags (utils/costmodel) in the TLS of
+        # WHICHEVER thread runs it — under an armed stage watchdog
+        # that is the gs-stage-watchdog helper, not this thread — so
+        # the tags are captured inside the callable and carried back
+        # through the closure for the span record below
+        disp_tags = {}
+
         def _call():
             faults.fire("dispatch")
-            return dispatch(dev)
+            out = dispatch(dev)
+            disp_tags.update(telemetry.pop_dispatch_tags())
+            return out
 
         t0 = time.perf_counter()
+        telemetry.pop_dispatch_tags()  # drop any stale pre-dispatch tag
         # dispatch is retries=0 too: engines fold the chunk into a
         # device-resident carry inside it, so re-running would
         # double-fold the chunk
@@ -548,7 +559,7 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
         par, ck = _span_cell({"tctx": tctx}, item)
         telemetry.record_span("ingress.dispatch", t0,
                               time.perf_counter() - t0, parent=par,
-                              chunk=ck)
+                              chunk=ck, **disp_tags)
         if pending is not None:
             done_chunk, pending = pending, None
             _finalize(*done_chunk)

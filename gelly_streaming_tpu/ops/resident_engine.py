@@ -348,6 +348,10 @@ class ResidentSummaryEngine(scan_analytics.StreamSummaryEngine):
         def run(carry, src_w, dst_w, valid_w):
             return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
 
+        # wrap_jit also feeds the cost observatory (utils/costmodel):
+        # the resident super-batch program's FLOPs/bytes land in the
+        # cost registry per signature, and armed dispatches tag their
+        # spans program="resident_fused"/sig for the attribution join
         self._run = metrics.wrap_jit(
             "resident_fused", jax.jit(run, **donate_kw()))
         self._run_c = None

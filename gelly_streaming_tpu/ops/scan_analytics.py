@@ -610,7 +610,10 @@ class StreamSummaryEngine(SummaryEngineBase):
             return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
 
         # compile watch (utils/metrics): distinct abstract signatures
-        # count against the O(log V) recompile envelope
+        # count against the O(log V) recompile envelope. The cost
+        # observatory (utils/costmodel) rides the same wrapper: armed,
+        # each signature's cost_analysis is captured and dispatches
+        # tag their ledger spans program="fused_scan"/sig.
         self._run = metrics.wrap_jit("fused_scan", run)
         self._body = body
         self._run_c = None  # compact twin, built on first use

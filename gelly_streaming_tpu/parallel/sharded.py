@@ -37,7 +37,7 @@ from .mesh import (SHARD_AXIS, make_mesh, mesh_padded_len,
 from ..ops import ingress_pipeline, scan_analytics
 from ..ops import segment as seg_ops
 from ..ops import triangles, unionfind
-from ..utils import faults, metrics, resilience, telemetry
+from ..utils import costmodel, faults, metrics, resilience, telemetry
 
 
 # ----------------------------------------------------------------------
@@ -770,6 +770,12 @@ class ShardedTriangleWindowKernel:
                                          sharding=sharding)
             ex = self._stream_fn(self.kb, self.cap).lower(
                 sds_i, sds_i, sds_b).compile()
+            # cost observatory (utils/costmodel): register the
+            # table-mode stream program's cost model (free off the AOT
+            # executable) and tag armed dispatches program/sig
+            ex = costmodel.wrap_exec(
+                "sharded_table_stream", ex,
+                metrics.abstract_sig((sds_i, sds_i, sds_b)))
             self._fns[key] = ex
         return ex
 
@@ -823,13 +829,13 @@ class ShardedTriangleWindowKernel:
             at, n = raw[:2]
             fire_shard_gather(self.n)
             # np.array (not asarray): device outputs are read-only views
-            c, b_ovf, k_ovf = (np.array(x)[:n] for x in raw[2:])
+            c, b_ovf, k_ovf = (np.array(x)[:n] for x in raw[2:])  # gslint: disable=host-sync (sanctioned finalize boundary: the sharded chunk's ONE batched gather of replicated [W] scalars)
             for w in np.nonzero(b_ovf + k_ovf)[0]:  # rare: exact redo
                 ws, wd = get_window(at + int(w))
                 c[w] = self.count(
                     ws, wd,
-                    failed_kb=self.kb if int(k_ovf[w]) else 0,
-                    failed_cap=self.cap if int(b_ovf[w]) else 0)
+                    failed_kb=self.kb if int(k_ovf[w]) else 0,  # gslint: disable=host-sync (k_ovf is a host numpy array — materialized by the finalize gather above, no device sync)
+                    failed_cap=self.cap if int(b_ovf[w]) else 0)  # gslint: disable=host-sync (b_ovf is a host numpy array — materialized by the finalize gather above, no device sync)
             counts.extend(int(x) for x in c)
 
         try:
@@ -849,8 +855,8 @@ class ShardedTriangleWindowKernel:
         COO chunk is laid out [W, eb] with the edge axis sharded over
         the mesh, a lax.map folds the windows, and overflowing windows
         are recounted individually down the escalation ladder."""
-        src = np.asarray(src, np.int32)
-        dst = np.asarray(dst, np.int32)
+        src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/python COO, never device arrays)
+        dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/python COO, never device arrays)
         if len(src) == 0:
             return []
         num_w, s, d, valid = seg_ops.window_stack(src, dst, self.eb,
@@ -1302,7 +1308,7 @@ class ShardedSummaryEngine(scan_analytics.SummaryEngineBase):
 
     def _materialize(self, raw):
         fire_shard_gather(self.n)
-        return tuple(np.array(x) for x in raw)
+        return tuple(np.array(x) for x in raw)  # gslint: disable=host-sync (sanctioned finalize boundary: the engine's ONE batched d2h gather per chunk, pipelined one chunk behind dispatch)
 
     def _redo(self, src, dst, b_ovf: int, k_ovf: int) -> int:
         return self._tri.count(

@@ -333,6 +333,31 @@ register("GS_HEALTH_STALE_S", "float", 30.0, lo=0.0,
               "0 disables the watchdog",
          default_text="30")
 
+# program cost observatory (utils/costmodel.py)
+register("GS_COSTMODEL", "bool", False,
+         help="arm the program cost observatory "
+              "(`utils/costmodel.py`): every wrapped jit/AOT program "
+              "captures its XLA `cost_analysis`/`memory_analysis` "
+              "(FLOPs, bytes) per abstract shape signature, and "
+              "dispatch spans carry program/signature tags the "
+              "attribution tools join on; off (the default) every "
+              "hook is a guarded no-op and the hot path is "
+              "bit-identical (armed, a jit-path program pays ONE "
+              "extra AOT compile per new signature)",
+         default_text="0 (off)")
+register("GS_COSTMODEL_PEAK_GFLOPS", "float", 197000.0, lo=1.0,
+         help="compute roofline peak (GFLOP/s) the boundedness "
+              "verdict and achieved fractions are computed against; "
+              "default is the public TPU v5e bf16 peak — on a CPU "
+              "backend the fractions are structure checks, not chip "
+              "numbers",
+         default_text="197000 (v5e bf16)")
+register("GS_COSTMODEL_PEAK_GBPS", "float", 819.0, lo=0.001,
+         help="memory-bandwidth roofline peak (GB/s) for the "
+              "bytes-vs-FLOPs boundedness verdict; default is the "
+              "public TPU v5e HBM peak",
+         default_text="819 (v5e HBM)")
+
 
 # ----------------------------------------------------------------------
 # docs rendering (README table; gslint R3 diffs it back)

@@ -64,6 +64,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from . import costmodel
 from . import knobs
 from . import telemetry
 
@@ -572,12 +573,19 @@ def wrap_jit(name: str, fn):
     seen = set()
 
     def wrapped(*args, **kwargs):
-        if enabled():
+        # the cost observatory (utils/costmodel) rides the same
+        # wrapper: armed, each call tags the pending dispatch-span
+        # attributes with (program, signature) and the first call at
+        # a new signature captures the program's XLA cost model
+        cm = costmodel.enabled()
+        if enabled() or cm:
             sig = abstract_sig(args, kwargs)
-            if sig not in seen:
+            if enabled() and sig not in seen:
                 if len(seen) < _SIG_CAP:
                     seen.add(sig)
                 note_compile(name, sig)
+            if cm:
+                costmodel.on_call(name, fn, sig, args, kwargs)
         return fn(*args, **kwargs)
 
     wrapped.__name__ = getattr(fn, "__name__", name)

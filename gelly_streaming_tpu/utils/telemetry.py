@@ -504,6 +504,29 @@ def record_span(name: str, t0: float, dur: float,
             a=attrs or None)
 
 
+# -- program-signature dispatch tags (the cost observatory) ------------
+def tag_dispatch(**tags) -> None:
+    """Bind program-identity attributes (program=..., sig=...) to THIS
+    thread's next dispatch-span record. Set by the dispatch wrappers
+    (utils/costmodel via metrics.wrap_jit / costmodel.wrap_exec, which
+    run INSIDE the dispatch call), consumed by the dispatch-span
+    record sites (ops/ingress_pipeline, the driver's snapshot-scan
+    step) via pop_dispatch_tags — so ledger spans carry the program
+    and abstract-shape signature the cost registry is keyed by."""
+    _TLS.dispatch_tags = tags
+
+
+def pop_dispatch_tags() -> dict:
+    """Take (and clear) the pending dispatch tags of this thread; {}
+    when none are bound. Cheap enough for disarmed hot paths: one
+    thread-local read."""
+    tags = getattr(_TLS, "dispatch_tags", None)
+    if tags is None:
+        return {}
+    _TLS.dispatch_tags = None
+    return tags
+
+
 # -- cross-thread chunk correlation (the ingress pipeline) -------------
 def chunk_ctx(chunk) -> Optional[dict]:
     """Open a chunk span handle the pool workers can parent their

@@ -24,7 +24,9 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_TELEMETRY GS_TRACE_DIR GS_TRACE_RING "
        "GS_TRACE_DURABLE GS_METRICS GS_METRICS_PORT "
        "GS_METRICS_SERIES GS_METRICS_COMPILE_BASE "
-       "GS_HEALTH_STALE_S").split()
+       "GS_HEALTH_STALE_S "
+       "GS_COSTMODEL GS_COSTMODEL_PEAK_GFLOPS "
+       "GS_COSTMODEL_PEAK_GBPS").split()
 
 _GETTERS = {"int": knobs.get_int, "float": knobs.get_float,
             "bool": knobs.get_bool, "str": knobs.get_str,

@@ -144,6 +144,21 @@ FIXTURE = {
                 "disarmed_edges_per_s": 24000000,
                 "armed_edges_per_s": 23500000,
                 "overhead_ratio": 1.021, "windows_observed": 16},
+    "cost_model": {"engine": "triangle_stream+fused_scan",
+                   "edge_bucket": 32768, "num_edges": 524288,
+                   "parity": True, "trace": "abc-123",
+                   "ledger": "logs/costmodel_ledger_cpu.jsonl",
+                   "peaks": {"gflops": 197000.0, "gbps": 819.0},
+                   "programs": [
+                       {"program": "fused_scan",
+                        "sig": "i32[16,32768],b1[16,32768]",
+                        "flops": 47352212,
+                        "bytes_accessed": 186835344,
+                        "arith_intensity_flops_per_byte": 0.2534,
+                        "bound": "bytes", "dispatches": 1,
+                        "measured_mean_s": 0.2376,
+                        "roofline_s": 0.000228,
+                        "roofline_frac": 0.00096}]},
     "regressions": [{"row": "bench[triangle]", "field": "value",
                      "baseline": 100, "current": 50, "ratio": 0.5,
                      "tolerance": 0.2}],
@@ -170,7 +185,9 @@ def test_render_covers_every_new_section():
                    "wb=64", "DEGRADED RUN", "Roofline",
                    "Ingress pipeline per-stage timing",
                    "Flight recorder", "ingress.prep", "1.010",
-                   "Metrics plane", "1.021"):
+                   "Metrics plane", "1.021",
+                   "Program cost observatory", "fused_scan",
+                   "explain_perf"):
         assert needle in block, needle
 
 
@@ -376,6 +393,341 @@ def test_trace_report_exits_nonzero_on_empty_and_torn(tmp_path,
     assert "nothing to report" in capsys.readouterr().err
     assert trace_report.main([LEDGER_FIXTURE,
                               "--trace-id", "fixture-1"]) == 0
+
+
+# ----------------------------------------------------------------------
+# cost_model schema + the BENCH capture shape (round 13)
+# ----------------------------------------------------------------------
+def test_schema_rejects_malformed_cost_model():
+    bad = {"backend": "cpu",
+           "cost_model": {"engine": "t"}}          # missing keys
+    joined = "\n".join(perf_schema.validate(bad))
+    assert "cost_model" in joined and "'programs'" in joined
+    bad = {"backend": "cpu",
+           "cost_model": {"programs": {"not": "a list"},
+                          "parity": True, "edge_bucket": 1,
+                          "trace": "t", "ledger": "l"}}
+    assert any("must be a list" in e for e in perf_schema.validate(bad))
+    bad = {"backend": "cpu",
+           "cost_model": {"programs": [{"program": "p"}],  # bare row
+                          "parity": True, "edge_bucket": 1,
+                          "trace": "t", "ledger": "l"}}
+    joined = "\n".join(perf_schema.validate(bad))
+    # flops/bytes may be null but the keys must EXIST (reported-none
+    # vs silently-dropped must stay distinguishable)
+    for key in ("'sig'", "'flops'", "'bytes_accessed'", "'bound'",
+                "'dispatches'"):
+        assert key in joined, key
+    ok = {"backend": "cpu",
+          "cost_model": {"programs": [
+              {"program": "p", "sig": "s", "flops": None,
+               "bytes_accessed": None, "bound": "unknown",
+               "dispatches": 0}],
+              "parity": True, "edge_bucket": 1,
+              "trace": "t", "ledger": "l"}}
+    assert perf_schema.validate(ok) == []
+
+
+def test_schema_validates_bench_capture_shape():
+    cap = {"n": 1, "cmd": "python bench.py", "rc": 0,
+           "tail": '{"metric": "x", "value": 1}\n', "parsed": None}
+    assert perf_schema.is_capture(cap)
+    assert perf_schema.validate_capture(cap) == []
+    assert not perf_schema.is_capture({"backend": "cpu"})
+    bad = {"cmd": "x", "rc": "zero", "tail": 3, "parsed": []}
+    errors = perf_schema.validate_capture(bad)
+    joined = "\n".join(errors)
+    assert "'tail'" in joined and "'rc'" in joined \
+        and "'parsed'" in joined
+
+
+@pytest.mark.parametrize("fname", [
+    "BENCH_r01.json", "BENCH_r02.json", "BENCH_r03.json",
+    "BENCH_r04.json", "BENCH_r05.json"])
+def test_committed_bench_captures_validate(fname):
+    """tools/ci_check.sh runs perf_schema over every committed
+    evidence file — the captures must stay valid too."""
+    path = os.path.join(REPO, fname)
+    if not os.path.exists(path):
+        pytest.skip("%s not committed" % fname)
+    with open(path) as f:
+        doc = json.load(f)
+    assert perf_schema.is_capture(doc)
+    assert perf_schema.validate_capture(doc) == []
+
+
+# ----------------------------------------------------------------------
+# bench_compare: null identity fields match missing ones (the
+# satellite fix), trace-ID correlation stamps
+# ----------------------------------------------------------------------
+def test_bench_compare_null_identity_treated_as_missing(tmp_path):
+    """A row whose `metric` is present-but-null must behave exactly
+    like a row without the key: no phantom `None` identity, so two
+    UNRELATED null-identity rows can never be compared against each
+    other as if they were the same row."""
+    # extract_rows: every supported shape drops the null-identity row
+    text = ('{"metric": null, "value": 100}\n'
+            '{"metric": "real", "value": 7}\n'
+            '{"value": 3}\n')
+    rows = bench_compare.extract_rows(text, "stdout")
+    assert set(rows) == {"real"}
+    cap = {"tail": text, "parsed": {"metric": None, "value": 100}}
+    assert set(bench_compare.extract_rows(cap, "cap")) == {"real"}
+    assert bench_compare.extract_rows(
+        {"metric": None, "value": 100, "tail_": 0}, "dict") == {}
+    # end-to-end: baseline and current each carry a DIFFERENT
+    # null-identity row (100 vs 10 — a 10× "drop" were they matched);
+    # the shared real row is unchanged, so the sentry must exit 0
+    base, cur = str(tmp_path / "b.jsonl"), str(tmp_path / "c.jsonl")
+    _write_jsonl(base, [{"metric": None, "value": 100},
+                        {"metric": "real", "value": 7}])
+    _write_jsonl(cur, [{"metric": None, "value": 10},
+                       {"metric": "real", "value": 7}])
+    assert bench_compare.main(
+        ["--baseline", base, "--current", cur]) == 0
+
+
+def test_bench_compare_stamps_trace_correlation(tmp_path, capsys):
+    """Bench rows carry the run trace ID; a regression report must
+    stamp baseline/current traces (top level AND per regression row)
+    so explain_perf --regression resolves the right ledger."""
+    base, cur = str(tmp_path / "b.jsonl"), str(tmp_path / "c.jsonl")
+    _write_jsonl(base, [{"metric": "t", "value": 100,
+                         "trace": "aaaa-1111"}])
+    _write_jsonl(cur, [{"metric": "t", "value": 10,
+                        "trace": "bbbb-2222"}])
+    out = str(tmp_path / "report.json")
+    rc = bench_compare.main(["--baseline", base, "--current", cur,
+                             "--out", out])
+    assert rc == 1
+    report = json.loads((tmp_path / "report.json").read_text())
+    assert perf_schema.validate(report) == []
+    assert report["baseline_trace"] == "aaaa-1111"
+    assert report["current_trace"] == "bbbb-2222"
+    reg = report["regressions"][0]
+    assert reg["baseline_trace"] == "aaaa-1111"
+    assert reg["current_trace"] == "bbbb-2222"
+    # the operator is told the drill-down command
+    assert "explain_perf.py --regression" in capsys.readouterr().err
+    # multi-run files: each regression follows ITS row's trace, not
+    # the document's first-seen one
+    _write_jsonl(base, [{"metric": "a", "value": 100,
+                         "trace": "runA-base"},
+                        {"metric": "b", "value": 100,
+                         "trace": "runB-base"}])
+    _write_jsonl(cur, [{"metric": "a", "value": 100,
+                        "trace": "runA-cur"},
+                       {"metric": "b", "value": 10,
+                        "trace": "runB-cur"}])
+    assert bench_compare.main(["--baseline", base, "--current", cur,
+                               "--out", out]) == 1
+    report = json.loads((tmp_path / "report.json").read_text())
+    reg = report["regressions"][0]
+    assert reg["row"] == "b"
+    assert reg["baseline_trace"] == "runB-base"
+    assert reg["current_trace"] == "runB-cur"
+
+
+# ----------------------------------------------------------------------
+# trace_report: cost-registry columns in the span table + Perfetto
+# ----------------------------------------------------------------------
+def _tagged_ledger_rows():
+    return [
+        {"t": "meta", "trace": "cost-1", "pid": 1,
+         "epoch": 1e9, "mono": 0.0, "ring": 4096},
+        {"t": "span", "name": "ingress.dispatch", "trace": "cost-1",
+         "tid": 1, "ts": 0.0, "dur": 0.25, "sid": 2,
+         "a": {"chunk": 0, "program": "fused_scan",
+               "sig": "i32[16,32768],b1[16,32768]"}},
+        {"t": "span", "name": "ingress.prep", "trace": "cost-1",
+         "tid": 1, "ts": 0.3, "dur": 0.01, "sid": 3,
+         "a": {"chunk": 0}},
+    ]
+
+
+def test_trace_report_span_table_carries_cost_columns(tmp_path):
+    cost = trace_report.cost_index(FIXTURE)
+    assert cost[("fused_scan",
+                 "i32[16,32768],b1[16,32768]")]["flops"] == 47352212
+    rows = {r["span"]: r
+            for r in trace_report.span_rows(_tagged_ledger_rows(),
+                                            cost)}
+    disp = rows["ingress.dispatch"]
+    assert disp["program"] == "fused_scan"
+    assert disp["flops"] == 47352212
+    assert disp["bytes_accessed"] == 186835344
+    assert disp["bound"] == "bytes"
+    assert "program" not in rows["ingress.prep"]   # untagged: no cols
+    # the rendered table shows the program + FLOPs/bytes annotation
+    text = trace_report.render(_tagged_ledger_rows(), cost=cost)
+    assert "fused_scan" in text
+    assert "GF" in text and "bytes" in text
+
+
+def test_trace_report_perfetto_args_carry_cost(tmp_path):
+    cost = trace_report.cost_index(FIXTURE)
+    trace = trace_report.to_perfetto(_tagged_ledger_rows(), cost)
+    disp = next(e for e in trace["traceEvents"]
+                if e["name"] == "ingress.dispatch")
+    assert disp["args"]["flops"] == 47352212
+    assert disp["args"]["bound"] == "bytes"
+    # the CLI end-to-end: --perf annotates, exports, exits 0
+    ledger = tmp_path / "l.jsonl"
+    _write_jsonl(str(ledger), _tagged_ledger_rows())
+    perf = tmp_path / "PERF.json"
+    perf.write_text(json.dumps(FIXTURE))
+    out = str(tmp_path / "trace.json")
+    assert trace_report.main([str(ledger), "--perf", str(perf),
+                              "--perfetto", out]) == 0
+    with open(out) as f:
+        evs = json.load(f)["traceEvents"]
+    assert any(e.get("args", {}).get("flops") for e in evs)
+
+
+# ----------------------------------------------------------------------
+# explain_perf: the attribution drill-down (tools/explain_perf.py)
+# ----------------------------------------------------------------------
+explain_perf = _load_tool("explain_perf")
+
+
+def test_explain_perf_committed_row_attributes(capsys):
+    """The acceptance pin: run on the committed 524K/32768 CPU row
+    (PERF_cpu.json cost_model + its committed ledger) — per-stage and
+    per-program attribution, stage totals reconciling with the ledger
+    within the default 5%, exit 0."""
+    perf = os.path.join(REPO, "PERF_cpu.json")
+    if not os.path.exists(perf):
+        pytest.skip("PERF_cpu.json not committed")
+    assert explain_perf.main(["--perf", perf]) == 0
+    out = capsys.readouterr().out
+    assert "stage attribution" in out
+    assert "reconciled: 100.0% mapped, tolerance 5.0%" in out
+    for program in ("fused_scan", "triangle_stream"):
+        assert program + "@" in out, program
+    assert "ranked suspects" in out
+
+
+def test_explain_perf_stage_attribution_and_containers():
+    """Leaf spans map to their stages; container spans are excluded
+    so time is never double-booked — by name (the known envelopes)
+    AND structurally (any span that parents another, even under an
+    unknown name); the two independent accountings agree."""
+    records = _tagged_ledger_rows() + [
+        {"t": "span", "name": "ingress.chunk", "trace": "cost-1",
+         "tid": 1, "ts": 0.0, "dur": 0.26, "sid": 1,
+         "a": {"chunk": 0}},                # known container: excluded
+        {"t": "span", "name": "step.triangles", "trace": "cost-1",
+         "tid": 1, "ts": 0.4, "dur": 0.51, "sid": 10},  # parents a
+        {"t": "span", "name": "ingress.finalize", "trace": "cost-1",
+         "tid": 1, "ts": 0.4, "dur": 0.5, "sid": 4, "par": 10,
+         "a": {"chunk": 0}},                # ...leaf: envelope excluded
+    ]
+    stages, attributed, ledger_total, unmapped = \
+        explain_perf.stage_attribution(records)
+    by_stage = {r["stage"]: r for r in stages}
+    assert by_stage["dispatch"]["total_s"] == 0.25
+    assert by_stage["prep"]["total_s"] == 0.01
+    # step.triangles maps to a stage but PARENTS the finalize span —
+    # only the child leaf counts, never both
+    assert by_stage["d2h+finalize"]["total_s"] == 0.5
+    assert by_stage["dispatch"]["count"] == 1
+    assert attributed == pytest.approx(0.76, abs=1e-6)
+    assert attributed == pytest.approx(ledger_total, rel=1e-3)
+    assert unmapped == []
+    # program attribution: the finalize span's d2h time lands on the
+    # program whose chunk it drained
+    progs = explain_perf.program_attribution(
+        records, FIXTURE["cost_model"]["programs"])
+    row = next(r for r in progs if r["program"] == "fused_scan")
+    assert row["dispatches"] == 1
+    assert row["materialize_s"] == 0.5
+    assert row["flops"] == 47352212
+
+
+def test_explain_perf_suspect_heuristics():
+    """A recompile_storm event and a finalize-dominated ledger each
+    fire their suspect, ranked by score."""
+    records = _tagged_ledger_rows() + [
+        {"t": "span", "name": "ingress.finalize", "trace": "cost-1",
+         "tid": 1, "ts": 0.4, "dur": 5.0, "sid": 4,
+         "a": {"chunk": 0}},
+        {"t": "event", "name": "recompile_storm", "trace": "cost-1",
+         "tid": 1, "ts": 0.5, "a": {"fn": "fused_scan"}},
+    ]
+    stages, _att, _led, _un = explain_perf.stage_attribution(records)
+    progs = explain_perf.program_attribution(
+        records, FIXTURE["cost_model"]["programs"])
+    suspects = explain_perf.rank_suspects(stages, progs, records)
+    names = [s["suspect"] for s in suspects]
+    assert "recompile_storm" in names
+    assert "host_sync" in names
+    assert "launch_bound" in names        # 0.25 s vs a sub-ms roofline
+    scores = [s["score"] for s in suspects]
+    assert scores == sorted(scores, reverse=True)
+    storm = next(s for s in suspects
+                 if s["suspect"] == "recompile_storm")
+    assert "fused_scan" in storm["evidence"]
+
+
+def test_explain_perf_unmapped_spans_fail_conservation(tmp_path,
+                                                       capsys):
+    """The taxonomy polices itself: leaf time under a span name the
+    stage map doesn't know (beyond --tolerance of the total) exits
+    non-zero and names the unmapped spans."""
+    ledger = tmp_path / "l.jsonl"
+    _write_jsonl(str(ledger), _tagged_ledger_rows() + [
+        {"t": "span", "name": "brand.new_stage", "trace": "cost-1",
+         "tid": 1, "ts": 1.0, "dur": 4.0, "sid": 7}])
+    assert explain_perf.main(["--ledger", str(ledger)]) == 1
+    err = capsys.readouterr().err
+    assert "could not name" in err
+    assert "brand.new_stage" in err
+    # inside tolerance the same ledger attributes fine
+    assert explain_perf.main(["--ledger", str(ledger),
+                              "--tolerance", "0.97"]) == 0
+
+
+def test_explain_perf_error_exits(tmp_path, capsys):
+    # no ledger resolvable → 2
+    assert explain_perf.main([]) == 2
+    assert "no ledger" in capsys.readouterr().err
+    # a ledger with no span records → 1, with the arming hint
+    empty = tmp_path / "empty.jsonl"
+    _write_jsonl(str(empty), [{"t": "meta", "trace": "x", "pid": 1,
+                               "epoch": 1e9, "mono": 0.0}])
+    assert explain_perf.main(["--ledger", str(empty)]) == 1
+    assert "GS_TELEMETRY=1" in capsys.readouterr().err
+
+
+def test_explain_perf_regression_correlation(tmp_path, capsys):
+    """The sentry→drill-down handoff: a bench_compare --out report's
+    current_trace selects the ledger records, and the regression rows
+    are echoed first."""
+    ledger = tmp_path / "l.jsonl"
+    rows = _tagged_ledger_rows()
+    # a second run's records under a different trace id: must be
+    # filtered OUT when the regression names trace cost-1
+    rows += [{"t": "span", "name": "ingress.dispatch",
+              "trace": "other-2", "tid": 1, "ts": 9.0, "dur": 9.0,
+              "sid": 9, "a": {"chunk": 0}}]
+    _write_jsonl(str(ledger), rows)
+    report = tmp_path / "report.json"
+    report.write_text(json.dumps({
+        "regressions": [{"row": "t", "field": "value",
+                         "baseline": 100, "current": 10, "ratio": 0.1,
+                         "tolerance": 0.2,
+                         "baseline_trace": "aaaa-1111",
+                         "current_trace": "cost-1"}],
+        "baseline_trace": "aaaa-1111", "current_trace": "cost-1"}))
+    rc = explain_perf.main(["--ledger", str(ledger),
+                            "--regression", str(report), "--json"])
+    assert rc == 0
+    captured = capsys.readouterr()
+    assert "regression: t.value 100 -> 10" in captured.err
+    doc = json.loads(captured.out)
+    # only the regression's trace was attributed (9 s span excluded)
+    assert doc["attributed_total_s"] == pytest.approx(0.26, abs=1e-6)
+    assert doc["regression"]["current_trace"] == "cost-1"
 
 
 def test_update_perf_md_appends_block_when_markers_absent(tmp_path):

@@ -1,0 +1,200 @@
+"""utils/tracing direct coverage (previously tested only through the
+driver): the `device_trace` graceful-capture contract and the
+StepTimer span adapter.
+
+- log-dir creation: the trace dir (nested) is created before the
+  profiler starts, so a fresh GS_TRACE_DIR-style path never fails the
+  capture it was meant to hold;
+- graceful path: a backend whose profiler cannot start degrades to a
+  no-op with a `device_trace_failed` telemetry event instead of
+  taking down the stream it was asked to observe, and stop is never
+  called for a start that failed;
+- nesting: jax.profiler allows ONE trace at a time — an inner
+  device_trace no-ops under the outermost one (exactly one
+  start/stop pair), including across threads;
+- the completed-capture stamp: a real CPU capture finishes clean and
+  leaves a durable `device_trace_captured` event carrying the log dir
+  and the cost observatory's program inventory — the join key that
+  makes an on-chip xprof capture attributable;
+- StepTimer: `step()` yields the telemetry span (so dispatch-owning
+  steps can attach program/sig attrs) while report()/event_log() keep
+  their accumulation semantics.
+"""
+
+import os
+import threading
+
+import pytest
+
+from gelly_streaming_tpu.utils import telemetry, tracing
+
+
+@pytest.fixture
+def armed(tmp_path, monkeypatch):
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", str(tmp_path / "ledger"))
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class _FakeProfiler:
+    """Deterministic stand-in for jax.profiler: counts start/stop
+    pairs, optionally fails on start."""
+
+    def __init__(self, fail_start=False):
+        self.starts = 0
+        self.stops = 0
+        self.fail_start = fail_start
+
+    def start_trace(self, log_dir):
+        if self.fail_start:
+            raise RuntimeError("profiler unavailable on this backend")
+        self.starts += 1
+
+    def stop_trace(self):
+        self.stops += 1
+
+
+@pytest.fixture
+def fake_profiler(monkeypatch):
+    import jax
+
+    prof = _FakeProfiler()
+    monkeypatch.setattr(jax, "profiler", prof)
+    return prof
+
+
+# ----------------------------------------------------------------------
+# log-dir creation + the one-start-one-stop contract
+# ----------------------------------------------------------------------
+def test_device_trace_creates_log_dir(tmp_path, fake_profiler):
+    log_dir = str(tmp_path / "traces" / "run0")  # nested, absent
+    with tracing.device_trace(log_dir):
+        assert os.path.isdir(log_dir)
+    assert (fake_profiler.starts, fake_profiler.stops) == (1, 1)
+
+
+def test_device_trace_nested_is_noop(tmp_path, fake_profiler):
+    log_dir = str(tmp_path / "t")
+    with tracing.device_trace(log_dir):
+        with tracing.device_trace(log_dir):
+            with tracing.device_trace(log_dir):
+                pass
+        # inner exits must not stop the outer capture
+        assert fake_profiler.stops == 0
+    assert (fake_profiler.starts, fake_profiler.stops) == (1, 1)
+
+
+def test_device_trace_nested_across_threads(tmp_path, fake_profiler):
+    """The nesting guard is process-global (jax.profiler is): a
+    concurrent capture from another thread no-ops too."""
+    log_dir = str(tmp_path / "t")
+    entered = threading.Event()
+    release = threading.Event()
+
+    def inner():
+        with tracing.device_trace(log_dir):
+            entered.set()
+            release.wait(timeout=10)
+
+    with tracing.device_trace(log_dir):
+        t = threading.Thread(target=inner)
+        t.start()
+        assert entered.wait(timeout=10)
+        assert fake_profiler.starts == 1    # inner never started
+        release.set()
+        t.join()
+    assert (fake_profiler.starts, fake_profiler.stops) == (1, 1)
+
+
+# ----------------------------------------------------------------------
+# graceful degradation
+# ----------------------------------------------------------------------
+def test_device_trace_failed_start_degrades_to_noop(
+        tmp_path, armed, monkeypatch):
+    import jax
+
+    prof = _FakeProfiler(fail_start=True)
+    monkeypatch.setattr(jax, "profiler", prof)
+    log_dir = str(tmp_path / "t")
+    with tracing.device_trace(log_dir):
+        pass                                # body still runs
+    assert prof.stops == 0                  # no stop for a failed start
+    evs = [r for r in telemetry.records() if r["t"] == "event"]
+    fail = next(e for e in evs if e["name"] == "device_trace_failed")
+    assert "profiler unavailable" in fail["a"]["error"]
+    assert not any(e["name"] == "device_trace_captured" for e in evs)
+
+
+def test_device_trace_body_exception_still_stops(tmp_path,
+                                                 fake_profiler):
+    with pytest.raises(ValueError):
+        with tracing.device_trace(str(tmp_path / "t")):
+            raise ValueError("stream died mid-capture")
+    assert (fake_profiler.starts, fake_profiler.stops) == (1, 1)
+
+
+# ----------------------------------------------------------------------
+# the real CPU path + the captured stamp feeding the observatory
+# ----------------------------------------------------------------------
+def test_device_trace_cpu_capture_stamps_durable_event(
+        tmp_path, armed, monkeypatch):
+    """End-to-end on the real jax.profiler (CPU): the capture
+    completes, and the durable `device_trace_captured` event carries
+    the log dir plus the cost observatory's program count — the
+    correlation record an on-chip xprof session is joined by."""
+    import jax.numpy as jnp
+
+    from gelly_streaming_tpu.utils import costmodel, metrics
+
+    monkeypatch.setenv("GS_COSTMODEL", "1")
+    costmodel.reset()
+    try:
+        log_dir = str(tmp_path / "xla")
+        with tracing.device_trace(log_dir):
+            fn = metrics.wrap_jit(
+                "trace_toy", __import__("jax").jit(lambda x: x + 1))
+            fn(jnp.arange(8)).block_until_ready()
+        assert os.path.isdir(log_dir)
+        evs = [r for r in telemetry.records() if r["t"] == "event"]
+        cap = next(e for e in evs
+                   if e["name"] == "device_trace_captured")
+        assert cap["a"]["log_dir"] == log_dir
+        # the program the capture profiled is in the inventory count
+        assert cap["a"]["programs"] >= 1
+        assert ("trace_toy", "i32[8]") in costmodel.programs()
+    finally:
+        costmodel.reset()
+
+
+# ----------------------------------------------------------------------
+# StepTimer: span-yield + unchanged accumulation semantics
+# ----------------------------------------------------------------------
+def test_steptimer_step_yields_span_for_attrs(armed):
+    timer = tracing.StepTimer()
+    with timer.step("snapshot_scan", num_records=4) as sp:
+        sp.attrs.update(program="fused_scan", sig="i32[4]")
+    rec = next(r for r in telemetry.records()
+               if r.get("name") == "step.snapshot_scan")
+    assert rec["a"]["program"] == "fused_scan"
+    assert rec["a"]["sig"] == "i32[4]"
+    rows = {r["op"]: r for r in timer.report()}
+    assert rows["snapshot_scan"]["records"] == 4
+    assert rows["snapshot_scan"]["calls"] == 1
+
+
+def test_steptimer_disarmed_report_unchanged(monkeypatch):
+    monkeypatch.setenv("GS_TELEMETRY", "0")
+    telemetry.reset()
+    try:
+        timer = tracing.StepTimer()
+        for _ in range(3):
+            with timer.step("intern", num_records=10):
+                pass
+        assert telemetry.records() == []
+        rows = {r["op"]: r for r in timer.report()}
+        assert rows["intern"]["calls"] == 3
+        assert rows["intern"]["records"] == 30
+    finally:
+        telemetry.reset()

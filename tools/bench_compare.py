@@ -94,9 +94,13 @@ def extract_rows(doc, label: str) -> dict:
         # bench.py's the-last-line-wins convention
         out[key] = row
 
+    # identity fields that are present-but-null are treated exactly
+    # like missing ones: a row {"metric": null} must neither create a
+    # phantom `None` identity nor match differently than a row that
+    # simply lacks the key (pinned by test_perf_tooling)
     if isinstance(doc, str):
         for row in _json_lines(doc):
-            if "metric" in row:
+            if row.get("metric") is not None:
                 add(row["metric"], row)
         return out
     if not isinstance(doc, dict):
@@ -105,13 +109,14 @@ def extract_rows(doc, label: str) -> dict:
     if "tail" in doc and isinstance(doc.get("tail"), str):
         # committed BENCH_r*.json capture
         for row in _json_lines(doc["tail"]):
-            if "metric" in row:
+            if row.get("metric") is not None:
                 add(row["metric"], row)
         parsed = doc.get("parsed")
-        if isinstance(parsed, dict) and "metric" in parsed:
+        if isinstance(parsed, dict) \
+                and parsed.get("metric") is not None:
             add(parsed["metric"], parsed)
         return out
-    if "metric" in doc:
+    if doc.get("metric") is not None:
         add(doc["metric"], doc)
         return out
     # PERF*.json evidence file
@@ -130,6 +135,31 @@ def extract_rows(doc, label: str) -> dict:
         if isinstance(meta, dict):
             add(meta_key, meta)
     return out
+
+
+def row_trace(row) -> str:
+    """The run trace ID one row carries (bench rows stamp `trace`,
+    armed ones nest it under `telemetry` too); None when absent."""
+    if not isinstance(row, dict):
+        return None
+    t = row.get("trace")
+    if isinstance(t, str) and t:
+        return t
+    tel = row.get("telemetry")
+    if isinstance(tel, dict) and isinstance(tel.get("trace"), str):
+        return tel["trace"]
+    return None
+
+
+def doc_trace(rows: dict) -> str:
+    """The first run trace ID any of a document's rows carries — the
+    correlation key that links a sentry regression to the ledger
+    tools/explain_perf.py drills into. None when no row carries one."""
+    for row in rows.values():
+        t = row_trace(row)
+        if t:
+            return t
+    return None
 
 
 def load_rows(path: str) -> dict:
@@ -216,6 +246,25 @@ def main(argv=None) -> int:
     report = compare(base_rows, cur_rows, args.tolerance)
     report["baseline_path"] = args.baseline
     report["current_path"] = args.current or args.baseline
+    # trace-ID correlation: a non-zero exit should link straight to
+    # its attributed cause — stamp the run trace IDs the rows carry
+    # so `tools/explain_perf.py --regression <report>` can find the
+    # right ledger without guesswork
+    base_trace, cur_trace = doc_trace(base_rows), doc_trace(cur_rows)
+    if base_trace:
+        report["baseline_trace"] = base_trace
+    if cur_trace:
+        report["current_trace"] = cur_trace
+    for r in report["regressions"]:
+        # per-row first: a file accumulated across several runs holds
+        # several trace IDs, and the drill-down must follow the
+        # REGRESSING row's run, not whichever row was seen first
+        bt = row_trace(base_rows.get(r["row"])) or base_trace
+        ct = row_trace(cur_rows.get(r["row"])) or cur_trace
+        if bt:
+            r["baseline_trace"] = bt
+        if ct:
+            r["current_trace"] = ct
 
     # the sentry's own output contract: a malformed `regressions`
     # section must fail HERE, not in a CI consumer
@@ -237,6 +286,9 @@ def main(argv=None) -> int:
             print("REGRESSION %s.%s: %s -> %s (x%.3f < 1-%.2f)"
                   % (r["row"], r["field"], r["baseline"], r["current"],
                      r["ratio"], args.tolerance), file=sys.stderr)
+        if args.out:
+            print("drill down: python tools/explain_perf.py "
+                  "--regression %s" % args.out, file=sys.stderr)
         return 1
     if not report["fields_compared"]:
         print("bench_compare: no overlapping rows/fields to compare",

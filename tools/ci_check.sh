@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# One-command CI gate: everything a PR must hold green, in the order
+# that fails fastest on the cheapest signal after the test suite.
+#
+#   1. tier-1 pytest (ROADMAP.md's verify command, CPU backend)
+#   2. gslint clean (no non-baselined findings, README in sync)
+#   3. perf_schema over every committed PERF*/BENCH_* evidence file
+#      (PERF files validate section shapes; BENCH files validate the
+#      capture shape)
+#   4. bench_compare --baseline BENCH_r05.json self-compare (the
+#      regression sentry's wiring smoke: must exit 0 on an unchanged
+#      baseline)
+#
+# Usage: tools/ci_check.sh [--skip-tests]
+#   --skip-tests  run only the static/evidence gates (seconds, not
+#                 minutes) — for pre-commit iteration; CI runs full.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if [[ "${1:-}" != "--skip-tests" ]]; then
+  echo "== [1/4] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider
+else
+  echo "== [1/4] tier-1 pytest SKIPPED (--skip-tests) =="
+fi
+
+echo "== [2/4] gslint =="
+python -m tools.gslint
+
+echo "== [3/4] perf_schema: committed PERF*/BENCH_* evidence =="
+evidence=(PERF*.json BENCH_*.json)
+python tools/perf_schema.py "${evidence[@]}"
+
+echo "== [4/4] bench_compare self-compare (BENCH_r05.json) =="
+python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
+
+echo "ci_check: all gates green"

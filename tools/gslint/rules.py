@@ -304,6 +304,13 @@ class JitPurityRule(Rule):
                         "records at trace time only (and its knob "
                         "gate freezes) — mark around the dispatch, "
                         "not inside it"))
+                elif cd.startswith("costmodel."):
+                    out.append(self.finding(
+                        ctx, node,
+                        "cost-observatory call inside traced code "
+                        "captures/tags at trace time only (and its "
+                        "knob gate freezes) — wrap the dispatch "
+                        "entry point, never the traced body"))
                 elif cd.startswith("knobs."):
                     out.append(self.finding(
                         ctx, node,
@@ -527,6 +534,8 @@ class ThreadSharedRule(Rule):
         PKG + "/parallel/sharded.py",
         PKG + "/utils/telemetry.py",
         PKG + "/utils/metrics.py",
+        PKG + "/utils/costmodel.py",
+        PKG + "/utils/tracing.py",
         PKG + "/utils/resilience.py",
         PKG + "/utils/faults.py",
         PKG + "/utils/interning.py",

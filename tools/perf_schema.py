@@ -66,7 +66,20 @@ DICT_SECTIONS = {
     # GS_METRICS ≤1.05× bar
     "metrics": ("engine", "parity", "overhead_ratio",
                 "disarmed_edges_per_s", "armed_edges_per_s"),
+    # program cost observatory (utils/costmodel, tools/
+    # profile_kernels.py section_cost_model): per-program FLOPs/bytes
+    # rows + the trace id of the committed attribution ledger
+    # tools/explain_perf.py drills into
+    "cost_model": ("programs", "parity", "edge_bucket", "trace",
+                   "ledger"),
 }
+
+# per-row required keys of the cost_model section's `programs` list
+# (flops/bytes may be null on a backend that doesn't report them, but
+# the keys must exist so a consumer can tell "not reported" from a
+# silently dropped capture)
+_COST_PROGRAM_KEYS = ("program", "sig", "flops", "bytes_accessed",
+                      "bound", "dispatches")
 
 # A/B sections whose parity-true rows must claim a positive speedup
 # (the adoption gates divide by it; rows_clear_bar rejects otherwise)
@@ -139,7 +152,54 @@ def validate(perf) -> list:
                 if key not in val:
                     errors.append("%s: missing required key %r"
                                   % (name, key))
+            if name == "cost_model":
+                rows = val.get("programs")
+                if not isinstance(rows, list):
+                    if "programs" in val:
+                        errors.append(
+                            "cost_model: 'programs' must be a list of "
+                            "rows, got %s" % type(rows).__name__)
+                else:
+                    for i, row in enumerate(rows):
+                        if not isinstance(row, dict):
+                            errors.append(
+                                "cost_model.programs[%d]: expected a "
+                                "dict row, got %s"
+                                % (i, type(row).__name__))
+                            continue
+                        for key in _COST_PROGRAM_KEYS:
+                            if key not in row:
+                                errors.append(
+                                    "cost_model.programs[%d]: missing "
+                                    "required key %r" % (i, key))
     return errors
+
+
+def validate_capture(doc) -> list:
+    """Error strings for one parsed BENCH_r*.json capture ({"n",
+    "cmd", "rc", "tail", "parsed"} — the shape bench runs commit and
+    tools/bench_compare.py reads); empty = clean."""
+    errors = []
+    if not isinstance(doc, dict):
+        return ["top level: expected a dict capture, got %s"
+                % type(doc).__name__]
+    if not isinstance(doc.get("tail"), str):
+        errors.append("capture: 'tail' must be the bench stdout tail "
+                      "string (got %r)" % type(doc.get("tail")).__name__)
+    if "rc" in doc and not isinstance(doc["rc"], int):
+        errors.append("capture: 'rc' must be an int exit status")
+    parsed = doc.get("parsed")
+    if parsed is not None and not isinstance(parsed, dict):
+        errors.append("capture: 'parsed' must be null or the last "
+                      "metric row dict")
+    return errors
+
+
+def is_capture(doc) -> bool:
+    """True for the BENCH_r*.json capture shape (tail + cmd/rc),
+    which main() routes to validate_capture instead of validate."""
+    return isinstance(doc, dict) and "tail" in doc \
+        and ("cmd" in doc or "rc" in doc)
 
 
 def main(paths=None) -> int:
@@ -153,7 +213,8 @@ def main(paths=None) -> int:
             print("%s: unreadable (%s)" % (path, e))
             rc = 1
             continue
-        errors = validate(perf)
+        errors = (validate_capture(perf) if is_capture(perf)
+                  else validate(perf))
         if errors:
             rc = 1
             for e in errors:

@@ -420,7 +420,10 @@ def section_dense(results: dict) -> None:
 
 def _cost_rows(compiled):
     """(flops, bytes_accessed) from XLA's cost model for an AOT-compiled
-    executable; (None, None) when the backend doesn't report them."""
+    executable; (None, None) when the backend doesn't report them.
+    Unwraps costmodel.wrap_exec wrappers (the kernels' cached stream
+    executables carry the raw executable on __wrapped__)."""
+    compiled = getattr(compiled, "__wrapped__", compiled)
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, list):
@@ -1266,6 +1269,101 @@ def section_metrics(results: dict) -> None:
     results["metrics"] = meta
 
 
+def section_cost_model(results: dict) -> None:
+    """Program cost observatory evidence (utils/costmodel): capture
+    XLA cost_analysis-derived FLOPs/bytes for the three hot stream
+    programs — the triangle stream executable, the fused scan, and
+    the resident super-batch — on the 524K/32768 row, joined with the
+    measured dispatch spans of an armed flight-recorder run whose
+    ledger is COMMITTED (logs/costmodel_ledger_cpu.jsonl) so
+    tools/explain_perf.py has a real attribution substrate in tier-1.
+    Results are asserted digest-identical armed vs disarmed (the
+    observatory observes, never participates)."""
+    import hashlib
+    import shutil
+    import tempfile
+
+    from bench import make_stream
+    from gelly_streaming_tpu.ops.resident_engine import (
+        ResidentSummaryEngine)
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+    from gelly_streaming_tpu.ops.triangles import TriangleWindowKernel
+    from gelly_streaming_tpu.utils import costmodel, knobs, telemetry
+
+    eb, vb = 32768, 65536
+    edges = int(os.environ.get("GS_TELEMETRY_EDGES", 524288))
+    src, dst = make_stream(edges, vb)
+
+    def digest(obj):
+        return hashlib.sha256(json.dumps(
+            obj, sort_keys=True, default=int).encode()).hexdigest()
+
+    prev = {k: os.environ.get(k)
+            for k in ("GS_COSTMODEL", "GS_TELEMETRY", "GS_TRACE_DIR")}
+    try:
+        os.environ["GS_COSTMODEL"] = "0"
+        os.environ["GS_TELEMETRY"] = "0"
+        kern = TriangleWindowKernel(edge_bucket=eb, vertex_bucket=vb)
+        eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+        res = ResidentSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+        base = {
+            "triangle_stream": list(kern._count_stream_device(src,
+                                                              dst)),
+            "fused_scan": eng.process(src, dst),
+            "resident": res.process(src, dst),
+        }
+        with tempfile.TemporaryDirectory(prefix="gs-costmodel-") as td:
+            os.environ["GS_COSTMODEL"] = "1"
+            os.environ["GS_TELEMETRY"] = "1"
+            os.environ["GS_TRACE_DIR"] = td
+            telemetry.reset()
+            costmodel.reset()
+            eng.reset()
+            res.reset()
+            armed = {
+                "triangle_stream": list(
+                    kern._count_stream_device(src, dst)),
+                "fused_scan": eng.process(src, dst),
+                "resident": res.process(src, dst),
+            }
+            for leg in base:
+                if digest(base[leg]) != digest(armed[leg]):
+                    raise AssertionError(
+                        "armed cost observatory changed the %s "
+                        "results — the zero-overhead contract is "
+                        "broken" % leg)
+            rows = costmodel.report()
+            trace = telemetry.trace_id()
+            telemetry.flush()
+            ledger_src = telemetry.ledger_path()
+            ledger_rel = "logs/costmodel_ledger_cpu.jsonl"
+            os.makedirs(os.path.join(REPO, "logs"), exist_ok=True)
+            shutil.copyfile(ledger_src,
+                            os.path.join(REPO, ledger_rel))
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        telemetry.reset()
+        costmodel.reset()
+    results["cost_model"] = {
+        "engine": "triangle_stream+fused_scan+resident",
+        "edge_bucket": eb,
+        "num_edges": edges,
+        "parity": True,
+        "trace": trace,
+        "ledger": ledger_rel,
+        "peaks": {
+            "gflops": knobs.get_float("GS_COSTMODEL_PEAK_GFLOPS"),
+            "gbps": knobs.get_float("GS_COSTMODEL_PEAK_GBPS"),
+        },
+        "programs": rows,
+    }
+
+
 def section_host_snapshot(results: dict) -> None:
     """Batched snapshot-analytics tiers: the driver's device scan vs
     the C++ carried union-find (native.snapshot_windows) — the
@@ -1521,6 +1619,9 @@ SECTIONS = {
     # super-batch form): wedge-prone on the tunneled chip, so it runs
     # with the other scan-class compiles at the END of the order
     "resident_ab": section_resident_ab,
+    # cost_model AOT-compiles the fused-scan/resident programs once
+    # more for their analyses: scan-class compiles, END of the order
+    "cost_model": section_cost_model,
     "fused": section_fused,
     "driver": section_driver,
 }

@@ -80,28 +80,56 @@ def meta_of(records: list) -> dict:
     return {}
 
 
-def span_rows(records: list) -> list:
+def span_rows(records: list, cost: dict = None) -> list:
     """Per-span-name latency rows, sorted by total time — the same
-    shape telemetry.summary() commits to PERF.json."""
+    shape telemetry.summary() commits to PERF.json. Spans tagged by
+    the cost observatory (program/sig attributes) group per program
+    signature and, when a cost index (`cost_index`) is given, carry
+    that program's FLOPs/bytes beside the latencies."""
     groups = {}
     for rec in records:
         if rec["t"] != "span":
             continue
-        groups.setdefault(rec["name"], []).append(
-            float(rec.get("dur", 0.0)))
+        a = rec.get("a") or {}
+        key = (rec["name"], a.get("program"), a.get("sig"))
+        groups.setdefault(key, []).append(float(rec.get("dur", 0.0)))
     rows = []
-    for name, durs in groups.items():
+    for (name, program, sig), durs in groups.items():
         pct = percentiles(durs)
-        rows.append({
+        row = {
             "span": name,
             "count": len(durs),
             "total_ms": round(sum(durs) * 1e3, 3),
             "p50_ms": round(pct[50] * 1e3, 3),
             "p95_ms": round(pct[95] * 1e3, 3),
             "p99_ms": round(pct[99] * 1e3, 3),
-        })
+        }
+        if program:
+            row["program"] = program
+            row["sig"] = sig
+            centry = (cost or {}).get((program, sig)) \
+                or (cost or {}).get((program, None))
+            if centry:
+                row["flops"] = centry.get("flops")
+                row["bytes_accessed"] = centry.get("bytes_accessed")
+                row["bound"] = centry.get("bound")
+        rows.append(row)
     rows.sort(key=lambda r: -r["total_ms"])
     return rows
+
+
+def cost_index(perf: dict) -> dict:
+    """{(program, sig) → cost row} from a PERF document's cost_model
+    section (plus a (program, None) fallback per program), so span
+    tables and Perfetto exports can carry FLOPs/bytes metadata."""
+    out = {}
+    for row in ((perf or {}).get("cost_model") or {}).get(
+            "programs") or []:
+        if not isinstance(row, dict):
+            continue
+        out[(row.get("program"), row.get("sig"))] = row
+        out.setdefault((row.get("program"), None), row)
+    return out
 
 
 def throughput_rows(records: list) -> list:
@@ -130,12 +158,15 @@ def event_rows(records: list) -> list:
     return out
 
 
-def to_perfetto(records: list) -> dict:
+def to_perfetto(records: list, cost: dict = None) -> dict:
     """Chrome trace-event JSON (the object form with `traceEvents`):
     one complete ('X') event per span with microsecond ts/dur, one
     instant ('i') event per recorded event, counters as 'C'. Span
     timestamps are the recorder's monotonic clock; the meta line's
-    epoch/mono anchor is attached as trace metadata."""
+    epoch/mono anchor is attached as trace metadata. With a cost
+    index (`cost_index`), program-tagged spans carry their FLOPs/
+    bytes/boundedness in the event args, so the exported flame view
+    explains each slice's cost model inline."""
     meta = meta_of(records)
     pid = meta.get("pid", 0)
     events = []
@@ -150,6 +181,13 @@ def to_perfetto(records: list) -> dict:
             "ts": round(float(rec.get("ts", 0.0)) * 1e6, 3),
         }
         args = dict(rec.get("a") or {})
+        if args.get("program") and cost:
+            centry = cost.get((args["program"], args.get("sig"))) \
+                or cost.get((args["program"], None))
+            if centry:
+                for k in ("flops", "bytes_accessed", "bound"):
+                    if centry.get(k) is not None:
+                        args[k] = centry[k]
         if kind == "span":
             events.append(dict(
                 base, ph="X", cat="span",
@@ -171,23 +209,29 @@ def to_perfetto(records: list) -> dict:
     }
 
 
-def render(records: list, top: int = 0) -> str:
+def render(records: list, top: int = 0, cost: dict = None) -> str:
     meta = meta_of(records)
     lines = ["ledger trace=%s pid=%s  (%d records)"
              % (meta.get("trace", "?"), meta.get("pid", "?"),
                 len(records)), ""]
-    rows = span_rows(records)
+    rows = span_rows(records, cost)
     if top:
         rows = rows[:top]
     if rows:
         lines += ["span                        count   total ms"
-                  "    p50 ms    p95 ms    p99 ms",
+                  "    p50 ms    p95 ms    p99 ms  program",
                   "-" * 78]
         for r in rows:
+            prog = r.get("program") or ""
+            if prog and r.get("flops"):
+                prog += "  [%.2fGF/%.0fMB %s]" % (
+                    r["flops"] / 1e9,
+                    (r.get("bytes_accessed") or 0) / 1e6,
+                    r.get("bound", "?"))
             lines.append(
-                "%-27s %5d %10.3f %9.3f %9.3f %9.3f"
+                "%-27s %5d %10.3f %9.3f %9.3f %9.3f  %s"
                 % (r["span"][:27], r["count"], r["total_ms"],
-                   r["p50_ms"], r["p95_ms"], r["p99_ms"]))
+                   r["p50_ms"], r["p95_ms"], r["p99_ms"], prog))
         lines.append("")
     thr = throughput_rows(records)
     if thr:
@@ -225,7 +269,21 @@ def main(argv=None) -> int:
     ap.add_argument("--since", type=float, default=None,
                     help="keep only records with monotonic ts >= this "
                          "many seconds")
+    ap.add_argument("--perf", default=None,
+                    help="PERF*.json whose cost_model section "
+                         "annotates program-tagged spans with "
+                         "FLOPs/bytes (table + Perfetto args)")
     args = ap.parse_args(argv)
+
+    cost = None
+    if args.perf:
+        try:
+            with open(args.perf) as f:
+                cost = cost_index(json.load(f))
+        except (OSError, ValueError) as e:
+            print("trace_report: unreadable --perf %s (%s)"
+                  % (args.perf, e), file=sys.stderr)
+            return 1
 
     records = load(args.ledger)
     if not records:
@@ -250,14 +308,14 @@ def main(argv=None) -> int:
     if args.json:
         print(json.dumps({
             "meta": meta_of(records),
-            "spans": span_rows(records)[:args.top or None],
+            "spans": span_rows(records, cost)[:args.top or None],
             "throughput": throughput_rows(records),
             "events": event_rows(records),
         }, indent=2, default=str))
     else:
-        print(render(records, args.top))
+        print(render(records, args.top, cost))
     if args.perfetto:
-        trace = to_perfetto(records)
+        trace = to_perfetto(records, cost)
         with open(args.perfetto, "w") as f:
             json.dump(trace, f)
         print("wrote %s (%d trace events)"
