@@ -628,8 +628,90 @@ def run_reduce_leg(metric_suffix: str = "") -> None:
     }), flush=True)
 
 
+def run_cohort_leg(metric_suffix: str = "") -> None:
+    """Multi-tenant cohort serving scenario (core/tenancy.py): N
+    small tenant streams fed window by window, the cohort's ONE
+    vmapped dispatch per round vs N sequential single-tenant engines
+    — the 'thousands of small streams' serving shape the ROADMAP
+    north star names. Per-tenant sha256 parity asserted before any
+    speedup is claimed (tools/tenancy_ab.py owns the deeper
+    median-of-3 committed evidence; this leg keeps the regression
+    sentry's eye on it every bench run)."""
+    from tools.tenancy_ab import (cohort_run, digest_summaries,
+                                  make_tenant_streams,
+                                  sequential_oracle)
+
+    tenants, windows, eb, vb = 8, 8, 512, 1024
+    streams = make_tenant_streams(tenants, windows, eb, vb)
+    total_edges = sum(len(s) for s, _d in streams.values())
+    want = sequential_oracle(streams, eb, vb, True)
+    got = cohort_run(streams, eb, vb, True)
+    for tid in streams:
+        assert digest_summaries(got[tid]) == digest_summaries(
+            want[tid]), "cohort diverged from the sequential " \
+            "oracle for tenant %s" % tid
+    reps = int(os.environ.get("GS_BENCH_REPS", "3"))
+    seq_ts, coh_ts = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        sequential_oracle(streams, eb, vb, True)
+        seq_ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        cohort_run(streams, eb, vb, True)
+        coh_ts.append(time.perf_counter() - t0)
+    seq_s = float(np.median(seq_ts))
+    coh_s = float(np.median(coh_ts))
+
+    from gelly_streaming_tpu.ops import autotune as _autotune
+    from gelly_streaming_tpu.utils import knobs as _knobs
+    from gelly_streaming_tpu.utils import telemetry as _telemetry
+
+    print(json.dumps({
+        "metric": "edges/sec/chip, multi-tenant cohort serving "
+                  "(%d tenants, %d-edge windows, one vmapped "
+                  "dispatch per round)%s"
+                  % (tenants, eb, metric_suffix),
+        "value": round(total_edges / coh_s),
+        "unit": "edges/s",
+        "tenants": tenants,
+        "num_edges": total_edges,
+        "tenant_edges_per_s": round(total_edges / coh_s),
+        "sequential_edges_per_s": round(total_edges / seq_s),
+        "cohort_speedup": round(seq_s / coh_s, 2),
+        # chosen-knob provenance, like every bench row: what dispatch
+        # configuration the cohort actually ran
+        "knobs": {"eb": eb, "vb": vb,
+                  "tenants_per_dispatch": _knobs.get_int(
+                      "GS_TENANT_TPD") or "auto",
+                  "queue_windows": _knobs.get_int(
+                      "GS_TENANT_QUEUE_WINDOWS"),
+                  "admission": _knobs.get_str("GS_TENANT_ADMISSION")},
+        "autotune": {"enabled": _autotune.enabled()},
+        # trace-ID correlation (see the triangles leg's row)
+        "trace": _telemetry.trace_id(),
+    }), flush=True)
+
+
 def main():
     metric_suffix = ""
+    if os.environ.get("GS_BENCH_COHORT"):
+        # cohort-leg child (same re-exec/watchdog/capacity contract
+        # as the scale children)
+        if "--cpu" in sys.argv or os.environ.get(
+                "GS_BENCH_CPU_FALLBACK") == "1":
+            from gelly_streaming_tpu.core.platform import use_cpu
+            use_cpu()
+        try:
+            run_cohort_leg(os.environ.get("GS_BENCH_SUFFIX", ""))
+        except AssertionError:
+            raise  # parity failure: NEVER mask a correctness regression
+        except Exception as e:
+            if _is_resource_error(e) or _is_backend_drop(e):
+                print("cohort leg: %s: %s" % (type(e).__name__, e),
+                      file=sys.stderr)
+                sys.exit(EXIT_CAPACITY)
+            raise
+        return
     if os.environ.get("GS_BENCH_REDUCE"):
         # reduce-leg child (same re-exec/watchdog/capacity contract as
         # the scale children)
@@ -728,6 +810,17 @@ def main():
     if rc:
         print("reduce leg rc=%d (capacity/timeout); triangle scales "
               "kept" % rc, file=sys.stderr)
+
+    # multi-tenant cohort serving leg (core/tenancy.py) — watchdogged
+    # like the others; capacity/timeout keeps the completed lines, a
+    # parity failure still fails the bench
+    rc = run_scale_watchdogged(0.0, metric_suffix,
+                               extra_env={"GS_BENCH_COHORT": "1"})
+    if rc not in (0, EXIT_CAPACITY, EXIT_TIMEOUT):
+        sys.exit(rc)
+    if rc:
+        print("cohort leg rc=%d (capacity/timeout); other lines kept"
+              % rc, file=sys.stderr)
 
 
 EXIT_CAPACITY = 3

@@ -303,7 +303,8 @@ class StreamingAnalyticsDriver:
                  mesh=None, tracing: bool = False,
                  emit_deltas: bool = False,
                  snapshot_tier: str = None,
-                 egress: str = None):
+                 egress: str = None,
+                 tenant: str = None):
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
@@ -324,6 +325,12 @@ class StreamingAnalyticsDriver:
         # tools/egress_ab.py) or committed-evidence resolution
         # (ops/delta_egress.resolve_egress); sharded meshes always full
         self._egress_pin = egress
+        # multi-tenant label (core/tenancy.py serving story): when this
+        # driver serves ONE stream of a multi-tenant deployment, its
+        # health-plane marks and demotion records carry the tenant so
+        # /healthz per-tenant liveness and the degradations evidence
+        # name the stream, not just "driver"
+        self.tenant = None if tenant is None else str(tenant)
         self.window_ms = window_ms
         self.analytics = tuple(analytics)
         # batched snapshot analytics tier: explicit pin (tests, the
@@ -594,7 +601,7 @@ class StreamingAnalyticsDriver:
         windows are count-based `edge_bucket`-sized chunks (the
         ingestion-time analog at a fixed batch rate). `_starts` lets
         stream_file pass its already-computed window assignment."""
-        metrics.on_stream_start("driver")
+        metrics.on_stream_start("driver", tenant=self.tenant)
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
         if _starts is not None or (
@@ -958,7 +965,8 @@ class StreamingAnalyticsDriver:
                             "re-promotion probe failed (%s: %s); "
                             "probation restarted"
                             % (type(e).__name__, e),
-                            mesh_shape=self._mesh_shape())
+                            mesh_shape=self._mesh_shape(),
+                            tenant=self.tenant)
                         self._demotions.append(event)
                         self._demoted_at = self.windows_done
                         return prev
@@ -967,7 +975,8 @@ class StreamingAnalyticsDriver:
                     self.windows_done,
                     "re-promotion probe after %d probation windows"
                     % (self.windows_done - self._demoted_at),
-                    mesh_shape=self._mesh_shape())
+                    mesh_shape=self._mesh_shape(),
+                    tenant=self.tenant)
                 self._demotions.append(event)
                 if self.timer:
                     self.timer.event("tier_repromotion", event)
@@ -1016,7 +1025,8 @@ class StreamingAnalyticsDriver:
             event = resilience.record_demotion(
                 "snapshot", tier, nxt, self.windows_done,
                 "%s: %s" % (type(err).__name__, err),
-                mesh_shape=self._mesh_shape(), shard_id=shard_id)
+                mesh_shape=self._mesh_shape(), shard_id=shard_id,
+                tenant=self.tenant)
             self._demotions.append(event)
             if self.timer:
                 self.timer.event("tier_demotion", event)
@@ -1186,7 +1196,8 @@ class StreamingAnalyticsDriver:
             self.edges_done += edges
             metrics.mark_window(len(chunk), edges, engine="driver",
                                 tier=tier,
-                                mesh_shape=self._mesh_shape())
+                                mesh_shape=self._mesh_shape(),
+                                tenant=self.tenant)
             if closes_partial and at + len(chunk) >= num_w:
                 # the short final window lives in this chunk: the flag
                 # joins this boundary's state (and its checkpoint),
@@ -1968,7 +1979,8 @@ class StreamingAnalyticsDriver:
         metrics.mark_window(
             1, len(src), engine="driver",
             tier=self._demoted_tier or self._base_tier(),
-            mesh_shape=self._mesh_shape())
+            mesh_shape=self._mesh_shape(),
+            tenant=self.tenant)
         if self._ckpt_due():
             self._stage_ckpt()
         return res

@@ -1,0 +1,842 @@
+"""Multi-tenant cohort scheduler: N independent graph streams, ONE
+vmapped dispatch per window cohort.
+
+The observatory's committed verdict (PERF_cpu.json `cost_model`, ISSUE
+10) is that every hot program is bytes/launch-bound — the fused scan
+runs at 0.096% of roofline and the wall is per-DISPATCH, not
+per-stream. The ROADMAP north star ("millions of users") is thousands
+of SMALL independent streams, so the biggest available lever is
+amortizing each dispatch across many of them: this module admits N
+tenants, right-pads each tenant's next window(s) into a cohort slab
+`[N, W, eb]`, and issues one leading-axis-vmapped dispatch over the
+SAME fused scan body every summary engine runs
+(ops/scan_analytics.build_cohort_scan — the trick the sharded path
+already plays for panes, applied to tenants). Per-tenant results are
+bit-identical to N separate StreamSummaryEngine runs by construction
+(padded rows/windows fold as no-ops against the carry), asserted by
+tools/tenancy_ab.py and tests/test_tenancy.py.
+
+The serving pieces around the slab:
+
+- **Admission & backpressure.** `admit()` is capped at GS_TENANT_MAX
+  (typed `TenantRejected` + durable `tenant_rejected` event past it);
+  each tenant owns a bounded ingest queue of GS_TENANT_QUEUE_WINDOWS
+  windows, and `feed()` past capacity either raises a typed
+  `TenantBackpressure` (policy `reject`, the default — the caller owns
+  retry) or sheds the overflow with a durable event + counter (policy
+  `drop`). Slab prep for the NEXT dispatch batch rides the resident
+  tier's ingest ring (ops/resident_engine.IngestRing over the shared
+  ingress worker pool), so its bounded slots are the admission queue
+  between the host and the device — while batch k computes, batch
+  k+1's slab fills.
+- **Per-tenant state.** Each tenant carries its own (degrees, labels,
+  cover) slabs in the engine-shared checkpoint layout, so
+  `tenant_state_dict()` is interchangeable with
+  StreamSummaryEngine.load_state_dict at equal buckets — the
+  cohort→single demotion ladder and the per-tenant
+  checkpoint/kill→resume drills (tools/chaos_run.py tenant leg) are
+  layout conversions, not translations. Tenants may declare their own
+  vertex bucket: the cohort groups tenants by bucket signature and
+  dispatches one slab per group.
+- **Per-tenant demotion.** A tenant whose slab prep fails (poisoned
+  input, injected fault) demotes ALONE to its own single-tenant
+  StreamSummaryEngine seeded from its live carry
+  (utils/resilience.record_demotion stamps the `tenant` label); the
+  cohort keeps dispatching the healthy tenants. The sick tenant's
+  stream continues on the single tier — same summaries, its own
+  dispatches — and its checkpoints stay engine-interchangeable.
+- **Autotuning.** The dispatch autotuner (ops/autotune.DispatchTuner,
+  family `tenant_cohort`) gains a tenants-per-dispatch arm: pump
+  rounds chunk the ready tenants into `tpd`-sized vmapped dispatches
+  and feed the measured edges/s back. GS_TENANT_TPD pins the arm;
+  GS_AUTOTUNE=0 dispatches all ready tenants in one slab.
+- **Observability.** Every finalized tenant window marks
+  metrics.mark_window(tenant=...) — per-tenant window/edge counters
+  and staleness rows on /healthz + /metrics under the registry's
+  cardinality bound (past GS_METRICS_SERIES, new tenants collapse
+  into one `overflow` row instead of growing the registry) — and
+  cohort dispatches run under `cohort.dispatch` spans whose
+  tenant/window attrs tools/explain_perf.py aggregates.
+
+Windowed reduce rides the same cohort shape via
+ops/windowed_reduce.WindowedEdgeReduce.cohort_step (N tenants' windows
+as one [N, eb] segment-kernel stack); triangle counts (and the exact
+K-overflow recount) are inside the fused scan body itself.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops import ingress_pipeline
+from ..ops import resident_engine
+from ..ops import scan_analytics
+from ..ops import segment as seg_ops
+from ..ops import triangles as tri_ops
+from ..utils import checkpoint
+from ..utils import faults
+from ..utils import knobs
+from ..utils import metrics
+from ..utils import resilience
+from ..utils import telemetry
+
+
+# ----------------------------------------------------------------------
+# knobs (utils/knobs.py registry; live per-call reads)
+# ----------------------------------------------------------------------
+def max_tenants() -> int:
+    """Admission cap of the cohort (GS_TENANT_MAX, default 64)."""
+    return knobs.get_int("GS_TENANT_MAX")
+
+
+def queue_windows() -> int:
+    """Per-tenant ingest-queue depth in windows
+    (GS_TENANT_QUEUE_WINDOWS, default 8): queue capacity in edges is
+    depth x edge_bucket."""
+    return knobs.get_int("GS_TENANT_QUEUE_WINDOWS")
+
+
+def admission_policy() -> str:
+    """Queue-overflow policy (GS_TENANT_ADMISSION): `reject` (default)
+    raises typed TenantBackpressure accepting nothing; `drop` accepts
+    what fits and sheds the rest with a durable event."""
+    return knobs.get_str("GS_TENANT_ADMISSION")
+
+
+def pinned_tpd() -> int:
+    """GS_TENANT_TPD: tenants per vmapped dispatch; 0 = auto (the
+    tuner's arm, or all ready tenants with GS_AUTOTUNE=0)."""
+    return knobs.get_int("GS_TENANT_TPD")
+
+
+# ----------------------------------------------------------------------
+# typed admission errors (durable-stamped like StageError)
+# ----------------------------------------------------------------------
+class TenantError(RuntimeError):
+    """Base of the typed tenancy failures; `tenant` names the stream.
+    Construction stamps a durable `tenant_rejected` flight-recorder
+    event — an admission refusal is exactly the serving evidence the
+    run ledger exists for, and stamping here covers every raise
+    site by construction."""
+
+    EVENT = "tenant_rejected"
+
+    def __init__(self, message: str, tenant, _record: bool = True,
+                 _durable: bool = True, **attrs):
+        super().__init__(message)
+        self.tenant = tenant
+        if _record:
+            telemetry.event(self.EVENT, durable=_durable,
+                            tenant=str(tenant),
+                            kind=type(self).__name__, **attrs)
+            metrics.counter_inc("gs_tenant_rejections_total",
+                                kind=type(self).__name__)
+
+
+class TenantRejected(TenantError):
+    """Admission refused: the cohort is at GS_TENANT_MAX, the id is
+    unknown/closed, or a duplicate admit."""
+
+
+class TenantBackpressure(TenantError):
+    """A feed() overflowed the tenant's bounded queue under the
+    `reject` policy. Carries `queued` and `capacity` (edges) so the
+    caller can size its retry. The durable (fsync'd) ledger stamp
+    fires once per overflow EPISODE (reset when the queue drains) —
+    a producer retry loop against a full queue must not become
+    fsync-bound or flood the post-mortem ledger with identical
+    records; subsequent rejections in the episode stamp buffered
+    (non-durable) events."""
+
+    def __init__(self, message: str, tenant, queued: int,
+                 capacity: int, _durable: bool = True):
+        super().__init__(message, tenant, _durable=_durable,
+                         queued=queued, capacity=capacity)
+        self.queued = queued
+        self.capacity = capacity
+
+
+class _Tenant:
+    """One admitted stream: its bounded ingest queue, carried state in
+    the engine-shared layout, cursors, and (after demotion) its own
+    single-tenant engine."""
+
+    __slots__ = ("tid", "vb", "kb", "src", "dst", "carry",
+                 "windows_done", "closed_partial", "closing", "closed",
+                 "tier", "engine", "ckpt_policy", "dropped_edges",
+                 "bp_stamped")
+
+    def __init__(self, tid: str, vb: int, kb: int):
+        self.tid = tid
+        self.vb = vb
+        self.kb = kb
+        self.src = np.zeros(0, np.int32)
+        self.dst = np.zeros(0, np.int32)
+        self.bp_stamped = False    # durable-once-per-overflow-episode
+        self.carry = None          # lazy: built at first dispatch
+        self.windows_done = 0
+        self.closed_partial = False
+        self.closing = False
+        self.closed = False
+        self.tier = "cohort"       # "cohort" | "single"
+        self.engine = None         # demoted StreamSummaryEngine
+        self.ckpt_policy = None    # per-tenant CheckpointPolicy
+        self.dropped_edges = 0
+
+    @property
+    def queued(self) -> int:
+        return len(self.src)
+
+
+class TenantCohort:
+    """N independent graph streams through one vmapped fused-scan
+    dispatch per window cohort. See the module docstring for the
+    serving model; the API in driver order:
+
+        cohort = TenantCohort(edge_bucket=4096, vertex_bucket=8192)
+        cohort.admit("user-1"); cohort.admit("user-2", vertex_bucket=2048)
+        cohort.feed("user-1", src, dst)     # bounded; may reject
+        results = cohort.pump()             # {tenant: [summary, ...]}
+        results = cohort.close("user-1")    # flush the partial window
+
+    Summaries are the fused summary engines' dicts (max_degree /
+    num_components / odd_cycle / triangles), bit-identical per tenant
+    to a single StreamSummaryEngine fed the same stream."""
+
+    # windows of ONE tenant folded per dispatch ceiling: deep queues
+    # catch up wc windows per slab row instead of one round per window
+    MAX_WINDOWS_PER_DISPATCH = 8
+
+    def __init__(self, edge_bucket: int, vertex_bucket: int,
+                 k_bucket: int = 0,
+                 windows_per_dispatch: Optional[int] = None):
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self.default_vb = seg_ops.bucket_size(vertex_bucket)
+        self._kb_arg = k_bucket
+        self.wc = seg_ops.bucket_size(
+            windows_per_dispatch if windows_per_dispatch
+            else self.MAX_WINDOWS_PER_DISPATCH)
+        self.tenants: Dict[str, _Tenant] = {}
+        self._programs = {}        # (vb, kb, nb, wb) -> jitted cohort scan
+        self._pad_carries = {}     # (vb,) -> fresh host carry template
+        self._tri_redo = {}        # (vb, kb) -> escalated exact kernel
+        self._tuners = {}          # (vb,) -> DispatchTuner (tpd arm)
+        self._ring = resident_engine.IngestRing()
+        self._ckpt_dir = None
+        self._ckpt_every_n = 0
+        self._ckpt_every_s = 0.0
+        self._round_no = 0
+
+    # ------------------------------------------------------------------
+    # admission
+    # ------------------------------------------------------------------
+    def admit(self, tenant_id, vertex_bucket: Optional[int] = None,
+              k_bucket: Optional[int] = None) -> None:
+        """Admit one stream under the GS_TENANT_MAX cap. Tenants may
+        declare their own vertex bucket (the cohort groups slabs by
+        bucket signature); the k bucket follows the engines' tuned
+        default for the cohort's edge bucket."""
+        tid = str(tenant_id)
+        if tid in self.tenants:
+            raise TenantRejected(
+                "tenant %r is already admitted" % tid, tid,
+                reason="duplicate")
+        cap = max_tenants()
+        live = sum(1 for t in self.tenants.values() if not t.closed)
+        if live >= cap:
+            raise TenantRejected(
+                "cohort is at its GS_TENANT_MAX=%d admission cap; "
+                "tenant %r refused" % (cap, tid), tid,
+                reason="max_tenants", cap=cap)
+        vb = seg_ops.bucket_size(vertex_bucket if vertex_bucket
+                                 else self.default_vb)
+        kb = seg_ops.bucket_size(
+            k_bucket if k_bucket else
+            (self._kb_arg if self._kb_arg else tri_ops._tuned_kb(self.eb)))
+        t = _Tenant(tid, vb, kb)
+        if self._ckpt_every_n or self._ckpt_every_s:
+            t.ckpt_policy = checkpoint.CheckpointPolicy(
+                every_n_windows=self._ckpt_every_n,
+                every_seconds=self._ckpt_every_s)
+        self.tenants[tid] = t
+        telemetry.event("tenant_admitted", tenant=tid, vb=vb)
+        metrics.on_stream_start("cohort", tenant=tid)
+
+    def _tenant(self, tenant_id, for_feed: bool = False) -> _Tenant:
+        tid = str(tenant_id)
+        t = self.tenants.get(tid)
+        if t is None:
+            # record only on the serving surface (the feed path): a
+            # typo'd id in read-only introspection must not stamp
+            # ledger events or inflate the rejection counter
+            raise TenantRejected("unknown tenant %r (admit() first)"
+                                 % tid, tid, _record=for_feed,
+                                 reason="unknown")
+        if for_feed and (t.closed or t.closing):
+            raise TenantRejected(
+                "tenant %r is closed — its final (partial) window was "
+                "already cut" % tid, tid, reason="closed")
+        return t
+
+    # ------------------------------------------------------------------
+    # feed / backpressure
+    # ------------------------------------------------------------------
+    def feed(self, tenant_id, src, dst) -> int:
+        """Append edges to one tenant's bounded queue. Returns the
+        number of edges accepted. Past capacity
+        (GS_TENANT_QUEUE_WINDOWS x edge_bucket edges), the
+        GS_TENANT_ADMISSION policy decides: `reject` raises typed
+        TenantBackpressure accepting NOTHING (the caller owns retry —
+        an atomic refusal can't split a window across a retry
+        boundary), `drop` accepts what fits and sheds the rest with a
+        durable event + counter."""
+        t = self._tenant(tenant_id, for_feed=True)
+        if t.closed_partial:
+            # the engines' partial-window-must-be-final guard: a
+            # tenant restored from a checkpoint taken after its short
+            # final window was cut must not fold more windows on a
+            # carry whose boundaries are already misaligned
+            raise ValueError(
+                "tenant %r already closed a partial window (length "
+                "not a multiple of edge_bucket); it cannot accept "
+                "more of the stream" % t.tid)
+        src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+        dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+        if len(src) != len(dst):
+            raise ValueError("src/dst length mismatch")
+        if len(src) and (int(src.max()) >= t.vb  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
+                         or int(dst.max()) >= t.vb  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
+                         or int(src.min()) < 0 or int(dst.min()) < 0):  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
+            raise ValueError(
+                "tenant %r ids must be dense in [0, %d) — out-of-range "
+                "ids would scatter into another slot's carried state"
+                % (t.tid, t.vb))
+        capacity = queue_windows() * self.eb
+        room = capacity - t.queued
+        take = len(src)
+        if take > room:
+            durable = not t.bp_stamped  # once per overflow episode
+            t.bp_stamped = True
+            if admission_policy() == "reject":
+                raise TenantBackpressure(
+                    "tenant %r queue is full (%d queued of %d edge "
+                    "capacity; GS_TENANT_QUEUE_WINDOWS); pump() the "
+                    "cohort or retry later" % (t.tid, t.queued,
+                                               capacity),
+                    t.tid, queued=t.queued, capacity=capacity,
+                    _durable=durable)
+            take = max(0, room)
+            shed = len(src) - take
+            t.dropped_edges += shed
+            telemetry.event("tenant_rejected", durable=durable,
+                            tenant=t.tid, kind="drop", shed=shed)
+            metrics.counter_inc("gs_tenant_dropped_edges_total", shed,
+                                tenant=t.tid)
+        if take:
+            t.src = np.concatenate([t.src, src[:take]])
+            t.dst = np.concatenate([t.dst, dst[:take]])
+        metrics.gauge_set("gs_tenant_queue_edges", t.queued,
+                          tenant=t.tid)
+        return take
+
+    # ------------------------------------------------------------------
+    # cohort programs / carries
+    # ------------------------------------------------------------------
+    def _fresh_carry(self, vb: int):
+        """One tenant's zero-stream carry in the engine-shared layout
+        (SummaryEngineBase._init_carry)."""
+        key = (vb,)
+        tpl = self._pad_carries.get(key)
+        if tpl is None:
+            tpl = self._pad_carries[key] = (
+                np.zeros(vb + 1, np.int32),
+                np.arange(vb + 1, dtype=np.int32),
+                np.arange(2 * (vb + 1), dtype=np.int32))
+        return tuple(jnp.asarray(a) for a in tpl)
+
+    def _program(self, vb: int, kb: int, nb: int, wb: int):
+        """The jitted cohort program at this slab shape (one per
+        power-of-two (tenants, windows) bucket — ragged cohorts reuse
+        O(log N x log W) programs, never one per population). Wrapped
+        by the compile watch / cost observatory as `cohort_scan`."""
+        key = (vb, kb, nb, wb)
+        fn = self._programs.get(key)
+        if fn is None:
+            import jax
+
+            run = scan_analytics.build_cohort_scan(self.eb, vb, kb)
+            fn = self._programs[key] = metrics.wrap_jit(
+                "cohort_scan", jax.jit(run))
+        return fn
+
+    def _redo_kernel(self, vb: int, kb: int):
+        """The escalated exact triangle recount of one K-overflowing
+        window — the same 4x-K fallback every summary engine keeps."""
+        key = (vb, kb)
+        k = self._tri_redo.get(key)
+        if k is None:
+            k = self._tri_redo[key] = tri_ops.TriangleWindowKernel(
+                edge_bucket=self.eb, vertex_bucket=vb,
+                k_bucket=4 * kb)
+        return k
+
+    def _tuner(self, vb: int):
+        """The tenants-per-dispatch arm (ops/autotune.DispatchTuner,
+        family `tenant_cohort`): pump rounds chunk ready tenants into
+        tpd-sized dispatches and feed measured edges/s back. None when
+        the tuner is disabled (GS_AUTOTUNE=0) or GS_TENANT_TPD pins."""
+        from ..ops import autotune
+
+        if pinned_tpd() > 0 or not autotune.enabled():
+            return None
+        key = (vb,)
+        tuner = self._tuners.get(key)
+        if tuner is None:
+            cap = seg_ops.bucket_size(max_tenants())
+            tpds = sorted({max(1, cap // 4), max(1, cap // 2), cap})
+            tuner = self._tuners[key] = autotune.DispatchTuner(
+                "tenant_cohort:eb=%d:vb=%d" % (self.eb, vb),
+                {"tpd": tpds}, {"tpd": cap})
+        return tuner
+
+    def _resolve_tpd(self, vb: int, n_ready: int):
+        """(tpd, tuner_arm): the dispatch-batch width this round. Pin
+        wins; else the tuner's arm; else every ready tenant in one
+        slab (the GS_AUTOTUNE=0 static form)."""
+        pin = pinned_tpd()
+        if pin > 0:
+            return pin, None
+        tuner = self._tuner(vb)
+        if tuner is None:
+            return n_ready, None
+        arm = (tuner.best() if ingress_pipeline.forced_sync_active()
+               else tuner.next_round())
+        return arm["tpd"], arm
+
+    # ------------------------------------------------------------------
+    # the pump: rounds of vmapped cohort dispatches
+    # ------------------------------------------------------------------
+    def _take_windows(self, t: _Tenant) -> int:
+        """Full windows this tenant contributes to the next slab (plus
+        the final partial one once closing)."""
+        if t.tier != "cohort" or t.closed:
+            return 0
+        full = t.queued // self.eb
+        if t.closing and t.queued % self.eb and full < self.wc:
+            return min(full + 1, self.wc)
+        return min(full, self.wc)
+
+    def _prep_slab(self, batch: List[_Tenant], wins: List[int]):
+        """Right-pad each tenant's next `wins` windows into the cohort
+        slab [nb, wb, eb] (+ per-tenant failures for demotion). Runs
+        on the ingress worker pool via the ingest ring when available;
+        reads queues only — consumption happens at finalize."""
+        nb = seg_ops.bucket_size(len(batch))
+        wb = seg_ops.bucket_size(max(wins))
+        vb = batch[0].vb
+        s = np.full((nb, wb, self.eb), vb, np.int32)
+        d = np.full((nb, wb, self.eb), vb, np.int32)
+        valid = np.zeros((nb, wb, self.eb), bool)
+        real = []   # (tenant, row, windows, edges) actually packed
+        failed = []  # (tenant, repr(error)) -> demotion at finalize
+        for row, (t, w) in enumerate(zip(batch, wins)):
+            try:
+                faults.fire("tenant_prep", t.tid)
+                n = min(w * self.eb, t.queued)
+                flat_s = s[row].reshape(-1)
+                flat_d = d[row].reshape(-1)
+                flat_v = valid[row].reshape(-1)
+                flat_s[:n] = t.src[:n]
+                flat_d[:n] = t.dst[:n]
+                flat_v[:n] = True
+                real.append((t, row, w, n))
+            except faults.InjectedFault as e:
+                if e.fatal:
+                    raise  # the simulated hard kill: never isolated
+                failed.append((t, "%s: %s" % (type(e).__name__, e)))
+            except Exception as e:  # gslint: disable=except-hygiene (captured per tenant: finalize demotes the sick tenant via record_demotion and the cohort keeps dispatching)
+                failed.append((t, "%s: %s" % (type(e).__name__, e)))
+        return (nb, wb, s, d, valid, real, failed)
+
+    def _dispatch_batch(self, vb: int, kb: int, slab, out: dict,
+                        staged: list) -> int:
+        """One vmapped cohort dispatch + finalize. Returns the number
+        of edges covered (the tuner's measurement unit)."""
+        nb, wb, s, d, valid, real, failed = slab
+        for t, err in failed:
+            self._demote(t, "slab prep failed: %s" % err)
+        if not real:
+            return 0
+        carries = []
+        for t, _row, _w, _n in real:
+            if t.carry is None:
+                t.carry = self._fresh_carry(t.vb)
+            carries.append(t.carry)
+        by_row = {row: i for i, (_t, row, _w, _n) in enumerate(real)}
+        # pad rows (demoted-mid-prep or a non-power-of-two cohort)
+        # carry a fresh zero-stream state — built only when the slab
+        # actually has them (the steady-state full slab skips it)
+        pad = (self._fresh_carry(vb) if len(by_row) < nb else None)
+        stacked = tuple(
+            jnp.stack([carries[by_row[r]][leaf] if r in by_row
+                       else pad[leaf] for r in range(nb)])
+            for leaf in range(3))
+        run = self._program(vb, kb, nb, wb)
+        edges = sum(n for _t, _row, _w, n in real)
+        with telemetry.span("cohort.dispatch", tenants=len(real),
+                            windows=sum(w for _t, _r, w, _n in real),
+                            edges=edges):
+            faults.fire("cohort_dispatch")
+            new_carries, outs = resilience.call_guarded(
+                "dispatch", ("cohort", self._round_no),
+                lambda: run(stacked, jnp.asarray(s), jnp.asarray(d),
+                            jnp.asarray(valid)),
+                retries=0)  # carry-mutating: deadline only, never re-run
+        mats = tuple(np.array(x) for x in outs)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per dispatch)
+        mdeg, ncomp, odd, tri, ovf = mats
+        for t, row, w, n in real:
+            summaries = []
+            for j in range(w):
+                lo = j * self.eb
+                tri_w = int(tri[row, j])  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+                if int(ovf[row, j]):  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+                    tri_w = self._redo_kernel(t.vb, t.kb).count(
+                        t.src[lo:min(lo + self.eb, n)],
+                        t.dst[lo:min(lo + self.eb, n)])
+                summaries.append({
+                    "max_degree": int(mdeg[row, j]),  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+                    "num_components": int(ncomp[row, j]),  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+                    "odd_cycle": bool(odd[row, j]),
+                    "triangles": int(tri_w),  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+                })
+            t.carry = tuple(a[row] for a in new_carries)
+            t.src = t.src[n:]
+            t.dst = t.dst[n:]
+            t.bp_stamped = False  # queue drained: new overflow episode
+            t.windows_done += w
+            if n < w * self.eb:      # the final short window just cut
+                t.closed_partial = True
+            if t.closing and t.queued == 0:
+                t.closed = True
+            out.setdefault(t.tid, []).extend(summaries)
+            metrics.mark_window(w, n, engine="cohort", tier="cohort",
+                                tenant=t.tid)
+            metrics.gauge_set("gs_tenant_queue_edges", t.queued,
+                              tenant=t.tid)
+            self._stage_ckpt(t, staged)
+        return edges
+
+    def pump(self, max_rounds: Optional[int] = None,
+             only: Optional[str] = None) -> Dict[str, list]:
+        """Dispatch window cohorts while any tenant has a full window
+        queued (plus the final partial window of closing tenants);
+        demoted tenants run their own single-tenant engine alongside.
+        Returns {tenant: [summary dict, ...]} for every window
+        finalized by this call; due checkpoints are written at clean
+        return (the delivery boundary — the engines' staged
+        at-least-once contract). `only` restricts the pump to one
+        tenant (close()'s drain — other tenants' windows must never
+        be consumed by a call whose caller only reads one stream)."""
+        out: Dict[str, list] = {}
+        staged: list = []
+        rounds = 0
+        while max_rounds is None or rounds < max_rounds:
+            self._pump_singles(out, staged, only=only)
+            by_group: Dict[tuple, list] = {}
+            for tid in sorted(self.tenants):
+                if only is not None and tid != only:
+                    continue
+                t = self.tenants[tid]
+                if self._take_windows(t) > 0:
+                    by_group.setdefault((t.vb, t.kb), []).append(t)
+            if not by_group:
+                break
+            rounds += 1
+            self._round_no += 1
+            for (vb, kb), ready in sorted(by_group.items()):
+                tpd, arm = self._resolve_tpd(vb, len(ready))
+                batches = [ready[i:i + tpd]
+                           for i in range(0, len(ready), tpd)]
+                descs = [(b, [self._take_windows(t) for t in b])
+                         for b in batches]
+                with telemetry.span(
+                        "cohort.round", vb=vb, tenants=len(ready),
+                        edges=sum(min(w * self.eb, t.queued)
+                                  for b, ws in descs
+                                  for t, w in zip(b, ws))) as sp:
+                    edges = self._run_batches(vb, kb, descs, out,
+                                              staged)
+                if arm is not None and edges:
+                    tuner = self._tuner(vb)
+                    if tuner is not None:
+                        tuner.record(arm, edges, sp.elapsed)
+        for (vb,), tuner in self._tuners.items():
+            if not ingress_pipeline.forced_sync_active():
+                tuner.save()
+        for t, snap in staged:
+            checkpoint.save(self._ckpt_path(t.tid), snap)
+        return out
+
+    def _run_batches(self, vb: int, kb: int, descs, out: dict,
+                     staged: list) -> int:
+        """Dispatch one round's batches with the ingest ring prepping
+        batch k+1's slab on the worker pool while batch k computes
+        (batches within a round cover DISJOINT tenants, so lookahead
+        prep reads only queues no earlier batch consumes; across
+        rounds the pump re-plans after finalize)."""
+        edges = 0
+        if len(descs) == 1:
+            # one batch: a worker-pool round trip buys nothing — build
+            # the slab inline (the serving-shape hot path)
+            return self._dispatch_batch(vb, kb,
+                                        self._prep_slab(*descs[0]),
+                                        out, staged)
+        pending = {}
+        try:
+            for i, (batch, wins) in enumerate(descs):
+                if i not in pending:
+                    if not self._ring.submit(
+                            lambda bw: self._prep_slab(*bw), i,
+                            (batch, wins)):
+                        pending[i] = None  # inline fallback
+                    else:
+                        pending[i] = "ring"
+                # lookahead: top the ring up with the NEXT slab before
+                # dispatching this one (classic double buffering)
+                if i + 1 < len(descs) and i + 1 not in pending:
+                    if self._ring.submit(
+                            lambda bw: self._prep_slab(*bw), i + 1,
+                            descs[i + 1]):
+                        pending[i + 1] = "ring"
+                if pending[i] == "ring":
+                    fut, _item = self._ring.pop(i)
+                    slab = fut.result()
+                else:
+                    slab = self._prep_slab(*descs[i])
+                edges += self._dispatch_batch(vb, kb, slab, out,
+                                              staged)
+        except BaseException:
+            # a mid-round failure (stage timeout, fatal injected kill)
+            # must not strand prepped slabs in the ring — the NEXT
+            # pump re-plans from the queues, which finalize never
+            # consumed for undispatched batches
+            self._ring.drain()
+            raise
+        return edges
+
+    def _pump_singles(self, out: dict, staged: list,
+                      only: Optional[str] = None) -> None:
+        """Demoted tenants: their queued full windows (and the final
+        partial once closing) run through their OWN single-tenant
+        engine — per-tenant dispatches, identical summaries. The
+        engine marks the global health plane itself; the cohort adds
+        the per-tenant row."""
+        for tid in sorted(self.tenants):
+            if only is not None and tid != only:
+                continue
+            t = self.tenants[tid]
+            if t.tier != "single" or t.closed:
+                continue
+            n = (t.queued // self.eb) * self.eb
+            if t.closing:
+                n = t.queued
+            if n == 0:
+                if t.closing:
+                    t.closed = True
+                continue
+            src, dst = t.src[:n], t.dst[:n]
+            with telemetry.span("tenant.single", tenant=t.tid,
+                                edges=int(n)):
+                summaries = t.engine.process(src, dst)
+            t.src = t.src[n:]
+            t.dst = t.dst[n:]
+            t.bp_stamped = False  # queue drained: new overflow episode
+            t.windows_done = t.engine.windows_done
+            t.closed_partial = t.engine._closed_partial
+            if t.closing and t.queued == 0:
+                t.closed = True
+            out.setdefault(t.tid, []).extend(summaries)
+            metrics.mark_tenant(t.tid, len(summaries), int(n),
+                                tier="single")
+            self._stage_ckpt(t, staged)
+
+    def close(self, tenant_id) -> List[dict]:
+        """Cut the tenant's final (possibly partial) window and retire
+        it. Drains ONLY this tenant (pump(only=...)) — other tenants'
+        queued windows stay queued for the next pump(), so a close()
+        can never consume summaries its caller doesn't read."""
+        t = self._tenant(tenant_id)
+        if t.closed:
+            return []
+        t.closing = True
+        if t.queued == 0 and t.tier == "cohort":
+            t.closed = True
+            return []
+        out = self.pump(only=t.tid)
+        return out.get(t.tid, [])
+
+    # ------------------------------------------------------------------
+    # demotion (cohort → single-tenant engine)
+    # ------------------------------------------------------------------
+    def _demote(self, t: _Tenant, reason: str) -> None:
+        if t.tier == "single":
+            return
+        eng = scan_analytics.StreamSummaryEngine(
+            edge_bucket=self.eb, vertex_bucket=t.vb, k_bucket=t.kb)
+        eng.load_state_dict(self.tenant_state_dict(t.tid))
+        t.engine = eng
+        t.tier = "single"
+        resilience.record_demotion(
+            "tenant:%s" % t.tid, "cohort", "single",
+            t.windows_done, reason, tenant=t.tid)
+
+    def demote(self, tenant_id, reason: str = "operator") -> None:
+        """Operator hook: pull one tenant off the cohort tier onto its
+        own single-tenant engine (seeded from its live carry — exact).
+        The cohort keeps dispatching everyone else."""
+        self._demote(self._tenant(tenant_id), reason)
+
+    # ------------------------------------------------------------------
+    # checkpoints (per tenant; engine-interchangeable layout)
+    # ------------------------------------------------------------------
+    def tenant_state_dict(self, tenant_id) -> dict:
+        """One tenant's resumable state in EXACTLY the summary
+        engines' layout (ops/scan_analytics state_dict), so a cohort
+        checkpoint restores into a single-tenant StreamSummaryEngine
+        (the demotion ladder) and vice versa at equal buckets."""
+        t = self._tenant(tenant_id)
+        if t.tier == "single":
+            return t.engine.state_dict()
+        carry = (t.carry if t.carry is not None
+                 else self._fresh_carry(t.vb))
+        deg, labels, cover = (np.array(x) for x in carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: the tenant state_dict's one d2h)
+        return {
+            "edge_bucket": self.eb,
+            "vertex_bucket": t.vb,
+            "windows_done": int(t.windows_done),
+            "closed_partial": bool(t.closed_partial),
+            "carry": (deg, labels, cover),
+        }
+
+    def load_tenant_state_dict(self, tenant_id, state: dict) -> None:
+        t = self._tenant(tenant_id)
+        if state["edge_bucket"] != self.eb \
+                or state["vertex_bucket"] != t.vb:
+            raise ValueError(
+                "bucket mismatch: checkpoint was taken at eb=%d vb=%d, "
+                "tenant %r runs eb=%d vb=%d" % (
+                    state["edge_bucket"], state["vertex_bucket"],
+                    t.tid, self.eb, t.vb))
+        t.windows_done = int(state["windows_done"])  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
+        t.closed_partial = bool(state["closed_partial"])
+        t.carry = tuple(jnp.asarray(a) for a in state["carry"])
+        if t.tier == "single":
+            t.engine.load_state_dict(state)
+
+    def state_dict(self) -> dict:
+        """The whole cohort (cohort→cohort resume): per-tenant states
+        in the shared layout under their ids."""
+        return {
+            "edge_bucket": self.eb,
+            "tenants": {tid: self.tenant_state_dict(tid)
+                        for tid in sorted(self.tenants)},
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        if state["edge_bucket"] != self.eb:
+            raise ValueError(
+                "bucket mismatch: cohort checkpoint was taken at "
+                "eb=%d, this cohort runs eb=%d"
+                % (state["edge_bucket"], self.eb))
+        for tid, tstate in state["tenants"].items():
+            if tid not in self.tenants:
+                self.admit(tid,
+                           vertex_bucket=tstate["vertex_bucket"])  # gslint: disable=ckpt-symmetry (read from the PER-TENANT sub-state, which tenant_state_dict always writes — the cohort's own top level carries only edge_bucket + tenants)
+            self.load_tenant_state_dict(tid, tstate)
+
+    def enable_auto_checkpoint(self, directory: str,
+                               every_n_windows: int = 16,
+                               every_seconds: float = 0.0) -> None:
+        """Per-tenant auto-snapshots (`tenant_<id>.npz` under
+        `directory`, atomic + last-2 rotation — utils/checkpoint) on a
+        per-tenant CheckpointPolicy cadence, staged at dispatch
+        boundaries and flushed at pump()'s clean return (the delivery
+        boundary). A killed cohort resumes each tenant independently:
+        resume_all() / try_resume(tenant)."""
+        if every_n_windows <= 0 and every_seconds <= 0:
+            raise ValueError("checkpoint policy has no trigger enabled")
+        os.makedirs(directory, exist_ok=True)
+        self._ckpt_dir = directory
+        self._ckpt_every_n = max(0, every_n_windows)
+        self._ckpt_every_s = max(0.0, every_seconds)
+        for t in self.tenants.values():
+            if t.ckpt_policy is None:
+                t.ckpt_policy = checkpoint.CheckpointPolicy(
+                    every_n_windows=self._ckpt_every_n,
+                    every_seconds=self._ckpt_every_s)
+                t.ckpt_policy.mark(t.windows_done)
+
+    def _ckpt_path(self, tid: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in tid)
+        return os.path.join(self._ckpt_dir, "tenant_%s.npz" % safe)
+
+    def _stage_ckpt(self, t: _Tenant, staged: list) -> None:
+        if self._ckpt_dir is None or t.ckpt_policy is None:
+            return
+        if t.ckpt_policy.due(t.windows_done):
+            t.ckpt_policy.mark(t.windows_done)
+            staged.append((t, self.tenant_state_dict(t.tid)))
+
+    def try_resume(self, tenant_id) -> bool:
+        """Restore one tenant from its newest intact checkpoint
+        generation (rotation fallback — utils/checkpoint.load_latest);
+        False when nothing usable exists. After a True return, feed
+        the tenant from `resume_offset(tenant)` edges in."""
+        import warnings
+
+        t = self._tenant(tenant_id)
+        if self._ckpt_dir is None:
+            return False
+        try:
+            got = checkpoint.load_latest(self._ckpt_path(t.tid))
+        except checkpoint.CheckpointCorrupt as e:
+            warnings.warn(f"{e}; no intact generation — tenant "
+                          f"{t.tid!r} starts fresh")
+            return False
+        if got is None:
+            return False
+        state, used = got
+        self.load_tenant_state_dict(t.tid, state)
+        if t.ckpt_policy is not None:
+            t.ckpt_policy.mark(t.windows_done)
+        telemetry.event("resume", durable=True, component="tenant",
+                        tenant=t.tid, path=used,
+                        windows_done=t.windows_done)
+        return True
+
+    def resume_all(self) -> Dict[str, bool]:
+        """try_resume every admitted tenant; {tenant: resumed}."""
+        return {tid: self.try_resume(tid)
+                for tid in sorted(self.tenants)}
+
+    def resume_offset(self, tenant_id) -> int:
+        """Edges already folded into the tenant's carried state (the
+        windows_done cursor — windows are count-based eb-sized)."""
+        return self._tenant(tenant_id).windows_done * self.eb
+
+    # ------------------------------------------------------------------
+    # introspection
+    # ------------------------------------------------------------------
+    def tenant_tier(self, tenant_id) -> str:
+        return self._tenant(tenant_id).tier
+
+    def queued_edges(self, tenant_id) -> int:
+        return self._tenant(tenant_id).queued
+
+    def windows_done(self, tenant_id) -> int:
+        return self._tenant(tenant_id).windows_done

@@ -84,6 +84,28 @@ def _build_scan(eb: int, vb: int, kb: int):
     return body
 
 
+def build_cohort_scan(eb: int, vb: int, kb: int):
+    """The multi-tenant vmap entry (core/tenancy.py): the SAME scan
+    body as every fused summary engine, lifted over a leading tenant
+    axis — carries are [N, ...] slabs, edge slabs are [N, W, eb], and
+    one dispatch folds one window cohort across all N streams (the
+    trick the sharded path already plays for panes, applied to
+    tenants). Rows are independent by construction: a padded tenant
+    row (all-invalid windows) folds as a no-op against its carry, so
+    per-tenant results are bit-identical to N separate
+    StreamSummaryEngine runs — the parity contract tools/tenancy_ab.py
+    and tests/test_tenancy.py assert window by window."""
+    body = _build_scan(eb, vb, kb)
+
+    def one_tenant(carry, src_w, dst_w, valid_w):
+        return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
+
+    def run(carries, src, dst, valid):
+        return jax.vmap(one_tenant)(carries, src, dst, valid)
+
+    return run
+
+
 class SummaryEngineBase:
     """Shared scaffolding of the single-chip and sharded fused scan
     engines: carried-state reset/snapshot, the chunk loop, the

@@ -320,8 +320,8 @@ class WindowedEdgeReduce:
         # tiers want happens ONLY on their branches — converting
         # eagerly cost the native path two full-stream copies (~30% of
         # its runtime at the bench shape) for arrays it never reads.
-        src0, dst0 = np.asarray(src), np.asarray(dst)
-        val = np.asarray(val)
+        src0, dst0 = np.asarray(src), np.asarray(dst)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+        val = np.asarray(val)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         assert len(src0) == len(dst0) == len(val)
         n = len(src0)
         if n == 0:
@@ -438,7 +438,7 @@ class WindowedEdgeReduce:
                 order = np.argsort(ids, kind="stable")
                 res, _has = seg_ops.segmented_reduce_associative(
                     self.fn, ids[order], vals[order], n_cells)
-                cells = np.asarray(res).reshape(wb, vbp)
+                cells = np.asarray(res).reshape(wb, vbp)  # gslint: disable=host-sync (sanctioned finalize boundary: the associative tier's one materialize per chunk)
                 counts = np.bincount(
                     ids[ids < n_cells],
                     minlength=n_cells).reshape(wb, vbp)
@@ -496,11 +496,11 @@ class WindowedEdgeReduce:
                 # with the device kernels' own empty-segment identity,
                 # so rows are bit-identical to the full tier's
                 at, wb, cnt, idx, cv, cn = raw
-                cnt, idx, cv, cn = (np.asarray(x)
+                cnt, idx, cv, cn = (np.asarray(x)  # gslint: disable=host-sync (sanctioned finalize boundary: the delta wire's ONE batched d2h per chunk)
                                     for x in (cnt, idx, cv, cn))
                 fill = _device_cell_fill(self.name, cv.dtype)
                 for w in range(min(wb, num_w - at)):
-                    k = int(cnt[w])
+                    k = int(cnt[w])  # gslint: disable=host-sync (numpy-on-numpy: the materialize above already d2h'd the wire)
                     cells = np.full(vbp, fill, cv.dtype)
                     counts = np.zeros(vbp, cn.dtype)
                     cells[idx[w, :k]] = cv[w, :k]
@@ -508,7 +508,7 @@ class WindowedEdgeReduce:
                     out.append((cells, counts))
                 return
             at, wb, cells, counts = raw
-            cells, counts = np.asarray(cells), np.asarray(counts)
+            cells, counts = np.asarray(cells), np.asarray(counts)  # gslint: disable=host-sync (sanctioned finalize boundary: the stack program's ONE batched d2h per chunk)
             for w in range(min(wb, num_w - at)):
                 out.append((cells[w], counts[w]))
 
@@ -516,6 +516,69 @@ class WindowedEdgeReduce:
                                       finalize,
                                       timers=self.stage_timers)
         return out
+
+    def cohort_step(self, rows: List[tuple]) -> List[Tuple[np.ndarray,
+                                                           np.ndarray]]:
+        """Multi-tenant cohort entry (core/tenancy.py): fold N
+        tenants' next windows (each ≤ eb edges) in ONE device
+        dispatch — the windowed-reduce leg of the cohort slab. The
+        stack program already batches over a leading window axis and
+        tumbling windows carry no cross-window state, so a tenant
+        cohort is literally MORE WINDOWS IN THE STACK: row r of the
+        [nb, vbp] result is tenant r's window, bit-identical to that
+        tenant's own single-window device dispatch (the cell ids are
+        built by the same standard_chunk recipe, in the same order,
+        so even float accumulation folds identically).
+
+        `rows` is a list of (src, dst, val) triples; returns one
+        (values, counts) pair per row. Monoid kernels only (a user-fn
+        reduce runs its host-sorted flagged scan per tenant); egress
+        is the full [nb, vbp] stack — one cohort dispatch's d2h is
+        already amortized N ways."""
+        if not rows:
+            return []
+        if self.name is None:
+            raise ValueError("cohort_step serves the monoid stack "
+                             "kernels; user-fn reduces run per tenant")
+        import jax.numpy as jnp
+
+        eb, vbp = self.eb, self.vb + 1
+        nb = seg_ops.bucket_size(len(rows))
+        n_cells = nb * vbp
+        n_rows = len(rows)
+        s = np.zeros(nb * eb, np.int64)
+        d = np.zeros(nb * eb, np.int64)
+        # the shared value buffer takes the PROMOTED dtype across all
+        # rows (mixed cohorts fold in np.result_type, never silently
+        # truncating a wider row to the first row's dtype); rows that
+        # share a dtype — the normal cohort — keep it exactly
+        v = np.zeros(nb * eb, np.result_type(
+            *(np.asarray(val).dtype for _s, _d, val in rows)))  # gslint: disable=host-sync (host-input dtype probe: cohort rows are numpy/lists, never device values)
+        valid = np.zeros(nb * eb, bool)
+        for row, (src, dst, val) in enumerate(rows):
+            src = np.asarray(src, np.int64)  # gslint: disable=host-sync (host-input normalization: cohort rows are numpy/lists, never device values)
+            dst = np.asarray(dst, np.int64)  # gslint: disable=host-sync (host-input normalization: cohort rows are numpy/lists, never device values)
+            val = np.asarray(val)  # gslint: disable=host-sync (host-input normalization: cohort rows are numpy/lists, never device values)
+            if not len(src) == len(dst) == len(val):
+                raise ValueError("row %d: src/dst/val length mismatch"
+                                 % row)
+            if len(src) > eb:
+                raise ValueError(
+                    "row %d: %d edges exceed the %d-edge window bucket"
+                    % (row, len(src), eb))
+            lo = row * eb
+            s[lo:lo + len(src)] = src
+            d[lo:lo + len(dst)] = dst
+            v[lo:lo + len(val)] = val
+            valid[lo:lo + len(src)] = True
+        win = np.arange(nb * eb) // eb
+        ids, rep = self._cell_ids(s, d, win, valid, vbp, n_cells)
+        vals = np.concatenate([v] * rep)
+        fn = self._stack_fn(nb)
+        cells, counts = fn(jnp.asarray(ids), jnp.asarray(vals))
+        cells = np.asarray(cells)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort step's ONE batched d2h)
+        counts = np.asarray(counts)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort step's ONE batched d2h)
+        return [(cells[r], counts[r]) for r in range(n_rows)]
 
     # ---- host (numpy) tier -------------------------------------------
 

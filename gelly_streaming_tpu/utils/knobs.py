@@ -333,6 +333,29 @@ register("GS_HEALTH_STALE_S", "float", 30.0, lo=0.0,
               "0 disables the watchdog",
          default_text="30")
 
+# multi-tenant cohort scheduler (core/tenancy.py)
+register("GS_TENANT_MAX", "int", 64, lo=1,
+         help="admission cap of the multi-tenant cohort scheduler "
+              "(`core/tenancy.py`): tenants past it are refused with "
+              "a typed `TenantRejected` + a durable `tenant_rejected` "
+              "event instead of degrading every admitted stream")
+register("GS_TENANT_QUEUE_WINDOWS", "int", 8, lo=1,
+         help="per-tenant ingest-queue depth in windows (capacity = "
+              "depth x edge_bucket edges): the bounded backpressure "
+              "buffer between feed() and the cohort dispatch")
+register("GS_TENANT_ADMISSION", "str", "reject",
+         choices=("reject", "drop"),
+         help="queue-overflow policy: `reject` raises a typed "
+              "`TenantBackpressure` naming the tenant (accepting "
+              "nothing — the caller owns retry), `drop` accepts what "
+              "fits and sheds the rest with a durable event + counter")
+register("GS_TENANT_TPD", "int", 0, lo=0,
+         help="pin tenants-per-dispatch of the cohort slab; 0 "
+              "(default) lets the dispatch autotuner's "
+              "tenants-per-dispatch arm choose (all ready tenants in "
+              "one vmapped dispatch with GS_AUTOTUNE=0)",
+         default_text="0 (auto)")
+
 # program cost observatory (utils/costmodel.py)
 register("GS_COSTMODEL", "bool", False,
          help="arm the program cost observatory "

@@ -139,6 +139,11 @@ class _Registry:
         self.edges_total = 0
         self.edges_per_s_ema: Optional[float] = None
         self.engines: Dict[str, dict] = {}   # engine → tier/mesh info
+        # per-tenant window/edge counters + staleness clocks (the
+        # /healthz `tenants` section), bounded exactly like label
+        # sets: past the cardinality bound new tenants collapse into
+        # one `overflow` row (tenant_key below)
+        self.tenants: Dict[str, dict] = {}
 
     def series_key(self, name: str, labels: tuple) -> tuple:
         """Admit `labels` under the per-metric cardinality bound;
@@ -162,6 +167,24 @@ class _Registry:
             return _OVERFLOW_KEY
         seen.add(labels)
         return labels
+
+    def tenant_key(self, tenant: str) -> str:
+        """Admit one tenant id into the bounded per-tenant table —
+        the same collapse-don't-grow policy as series_key: past the
+        GS_METRICS_SERIES bound, new tenants share one `overflow` row
+        (each DISTINCT collapsed tenant counts once in
+        `dropped_series`, remembered in the same bounded set)."""
+        tenant = str(tenant)
+        if tenant in self.tenants:
+            return tenant
+        if len(self.tenants) >= max_series():
+            dropped = ("__tenants__", tenant)
+            if dropped not in self.dropped_seen \
+                    and len(self.dropped_seen) < 4 * max_series():
+                self.dropped_seen.add(dropped)
+                self.dropped_series += 1
+            return "overflow"
+        return tenant
 
 
 _REG: Optional[_Registry] = None
@@ -356,7 +379,8 @@ telemetry.register_sink(_sink, enabled)
 # ----------------------------------------------------------------------
 # window-finalize marks + health state (the wedged-tunnel detector)
 # ----------------------------------------------------------------------
-def on_stream_start(engine: str = "driver") -> None:
+def on_stream_start(engine: str = "driver",
+                    tenant: Optional[str] = None) -> None:
     """Stream entry mark: re-anchors the staleness clock (a stream
     that never finalizes its FIRST window is just as wedged as one
     that stops mid-way — and a stream starting long after the
@@ -367,18 +391,57 @@ def on_stream_start(engine: str = "driver") -> None:
     if not enabled():
         return
     reg = _reg()
+    now = clock()
     with reg.lock:
         reg.engines.setdefault(engine, {})
-        reg.last_finalize = clock()
+        reg.last_finalize = now
+        if tenant is not None:
+            # anchor the tenant's own staleness clock at admission so
+            # a stream admitted long after the cohort's last finalize
+            # is not flagged stale before its first window is due
+            info = reg.tenants.setdefault(reg.tenant_key(tenant), {})
+            info.setdefault("windows", 0)
+            info.setdefault("edges", 0)
+            info["last_finalize"] = now
     _maybe_serve()
+
+
+def mark_tenant(tenant: str, windows: int, edges: int,
+                tier: Optional[str] = None,
+                now: Optional[float] = None) -> None:
+    """Per-tenant finalize mark ONLY (the bounded tenants table +
+    tenant-labeled counters) — for window-finalize owners that already
+    fired the global mark_window themselves (a demoted tenant's
+    single-tenant engine marks globally inside process()); the cohort
+    dispatch path uses mark_window(tenant=...) which does both."""
+    if not enabled() or tenant is None:
+        return
+    reg = _reg()
+    now = clock() if now is None else now
+    with reg.lock:
+        key = reg.tenant_key(tenant)
+        info = reg.tenants.setdefault(key, {})
+        info["windows"] = info.get("windows", 0) + windows
+        info["edges"] = info.get("edges", 0) + edges
+        info["last_finalize"] = now
+        if tier is not None:
+            info["tier"] = tier
+    labels = {"tenant": key}
+    if tier is not None:
+        labels["tier"] = tier
+    counter_inc("gs_tenant_windows_total", windows, **labels)
+    counter_inc("gs_tenant_edges_total", edges, **labels)
 
 
 def mark_window(windows: int, edges: int, engine: str = "driver",
                 tier: Optional[str] = None,
                 mesh_shape: Optional[list] = None,
+                tenant: Optional[str] = None,
                 now: Optional[float] = None) -> None:
     """One window-finalize boundary: `windows` windows covering
-    `edges` edges were finalized by `engine` on `tier`. Drives the
+    `edges` edges were finalized by `engine` on `tier` (for `tenant`
+    when the finalize owner serves one — the multi-tenant cohort marks
+    once per tenant whose windows the dispatch covered). Drives the
     throughput counters/gauges AND resets the staleness clock; a
     finalize arriving while health is `degraded` is the recovery
     signal (durable `health_recovered` event)."""
@@ -410,6 +473,9 @@ def mark_window(windows: int, edges: int, engine: str = "driver",
     labels = {"engine": engine}
     if tier is not None:
         labels["tier"] = tier
+    if tenant is not None:
+        mark_tenant(tenant, windows, edges, tier=tier, now=now)
+        labels["tenant"] = str(tenant)
     counter_inc("gs_windows_finalized_total", windows, **labels)
     counter_inc("gs_edges_total", edges, **labels)
     if recovered_age is not None:
@@ -474,6 +540,25 @@ def health_snapshot(now: Optional[float] = None) -> dict:
                        "allowed": c.get("allowed"),
                        "storm": c["storm"]}
                 for name, c in reg.compiles.items()},
+            # per-tenant liveness: window/edge counters + the age of
+            # each tenant's OWN last finalize (bounded table — see
+            # tenant_key; a stale tenant is flagged per-row so one
+            # wedged stream is visible while the cohort stays ok)
+            "tenants": {
+                tid: {
+                    "windows": info.get("windows", 0),
+                    "edges": info.get("edges", 0),
+                    "tier": info.get("tier"),
+                    "last_finalize_age_s": (
+                        None if info.get("last_finalize") is None
+                        else round(now - info["last_finalize"], 3)),
+                    "stale": bool(
+                        stale_after_s() > 0
+                        and info.get("last_finalize") is not None
+                        and now - info["last_finalize"]
+                        > stale_after_s()),
+                }
+                for tid, info in reg.tenants.items()},
         }
     snap["demotions"] = resilience.demotion_events()[-5:]
     snap["trace"] = telemetry.trace_id()

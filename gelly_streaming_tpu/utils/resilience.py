@@ -212,7 +212,8 @@ _DEMOTIONS_LOCK = threading.Lock()
 def record_demotion(component: str, from_tier: str, to_tier: str,
                     window: int, reason: str,
                     mesh_shape: Optional[list] = None,
-                    shard_id: Optional[int] = None) -> dict:
+                    shard_id: Optional[int] = None,
+                    tenant: Optional[str] = None) -> dict:
     """Log one tier demotion (or a failed re-promotion probe). The
     process-global log is what tools/profile_kernels.py snapshots into
     PERF.json's `degradations` section, so a run that silently fell
@@ -233,6 +234,11 @@ def record_demotion(component: str, from_tier: str, to_tier: str,
         "mesh_shape": (None if mesh_shape is None
                        else [int(x) for x in mesh_shape]),
         "shard_id": None if shard_id is None else int(shard_id),
+        # multi-tenant provenance (core/tenancy.py): a demoted tenant's
+        # event names WHICH stream fell off the cohort tier, so the
+        # degradations evidence (and /healthz's demotion tail) can
+        # never blame the whole cohort for one sick stream
+        "tenant": None if tenant is None else str(tenant),
     }
     with _DEMOTIONS_LOCK:
         _DEMOTIONS.append(event)

@@ -173,6 +173,144 @@ def test_resident_engine_cross_tier_resume(tmp_path):
     assert head + d.process(src32[off:], dst32[off:]) == full
 
 
+def _cohort_streams(n_tenants=3, windows=6, eb=256, vb=256):
+    out = {}
+    for i in range(n_tenants):
+        n = windows * eb - (eb // 3 if i == 1 else 0)
+        s, d = _stream(n=n, v=vb - 10, seed=30 + i)
+        out["t%d" % i] = (s.astype(np.int32), d.astype(np.int32))
+    return out
+
+
+def _pump_all(co, streams, cursors, out, piece):
+    live = True
+    while live:
+        live = False
+        for tid, (s, d) in streams.items():
+            c = cursors[tid]
+            if c >= len(s):
+                continue
+            co.feed(tid, s[c:c + piece], d[c:c + piece])
+            cursors[tid] = min(len(s), c + piece)
+            live = True
+        for tid, res in co.pump().items():
+            out.setdefault(tid, []).extend(res)
+
+
+def test_tenant_cohort_kill_resume_cohort_to_cohort(tmp_path):
+    """Per-tenant auto-checkpoints through the .npz format: kill the
+    cohort mid-stream, resume EVERY tenant independently into a fresh
+    cohort (resume_all), re-feed from each tenant's own offset — the
+    positional at-least-once combine equals the uninterrupted
+    sequential runs."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    eb, vb = 256, 256
+    streams = _cohort_streams(eb=eb, vb=vb)
+    full = {tid: StreamSummaryEngine(edge_bucket=eb,
+                                     vertex_bucket=vb).process(s, d)
+            for tid, (s, d) in streams.items()}
+
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    for tid in streams:
+        co.admit(tid)
+    co.enable_auto_checkpoint(str(tmp_path / "tenants"),
+                              every_n_windows=2)
+    head, cursors = {}, {tid: 0 for tid in streams}
+    # feed/pump only the first 4 windows' worth, then "die"
+    for _ in range(4):
+        for tid, (s, d) in streams.items():
+            c = cursors[tid]
+            co.feed(tid, s[c:c + eb], d[c:c + eb])
+            cursors[tid] = min(len(s), c + eb)
+        for tid, res in co.pump().items():
+            head.setdefault(tid, []).extend(res)
+    del co
+
+    co2 = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    for tid in streams:
+        co2.admit(tid)
+    co2.enable_auto_checkpoint(str(tmp_path / "tenants"),
+                               every_n_windows=2)
+    resumed = co2.resume_all()
+    assert all(resumed.values())
+    final = {}
+    for tid, (s, d) in streams.items():
+        off = co2.resume_offset(tid)
+        assert off > 0 and off <= len(head[tid]) * eb
+        final[tid] = head[tid][:off // eb]
+    cursors = {tid: co2.resume_offset(tid) for tid in streams}
+    _pump_all(co2, streams, cursors, final, 2 * eb)
+    for tid in streams:
+        final[tid].extend(co2.close(tid))
+    assert final == full
+
+
+def test_tenant_checkpoint_demotes_to_single_engine(tmp_path):
+    """The cohort→single demotion ladder THROUGH the file format: a
+    per-tenant cohort checkpoint restores into a plain
+    StreamSummaryEngine (the state layouts are shared by
+    construction) and the single engine finishes the stream
+    bit-exactly."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    eb, vb = 256, 256
+    streams = _cohort_streams(n_tenants=2, eb=eb, vb=vb)
+    full = {tid: StreamSummaryEngine(edge_bucket=eb,
+                                     vertex_bucket=vb).process(s, d)
+            for tid, (s, d) in streams.items()}
+
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    for tid in streams:
+        co.admit(tid)
+    head, cursors = {}, {tid: 0 for tid in streams}
+    for _ in range(3):
+        for tid, (s, d) in streams.items():
+            c = cursors[tid]
+            co.feed(tid, s[c:c + eb], d[c:c + eb])
+            cursors[tid] = min(len(s), c + eb)
+        for tid, res in co.pump().items():
+            head.setdefault(tid, []).extend(res)
+    path = str(tmp_path / "t0.npz")
+    ck.save(path, co.tenant_state_dict("t0"))
+    del co
+
+    single = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    assert single.try_resume(path)
+    off = single.resume_offset()
+    s, d = streams["t0"]
+    tail = single.process(s[off:], d[off:])
+    assert head["t0"][:off // eb] + tail == full["t0"]
+
+
+def test_single_engine_checkpoint_resumes_into_cohort(tmp_path):
+    """The reverse ladder: a single-tenant StreamSummaryEngine
+    checkpoint loads into a cohort tenant (load_tenant_state_dict)
+    and the vmapped cohort finishes the stream bit-exactly — tenants
+    can migrate INTO the cohort tier, not just fall out of it."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    eb, vb = 256, 256
+    s, d = _stream(n=6 * eb, v=vb - 10, seed=44)
+    s, d = s.astype(np.int32), d.astype(np.int32)
+    full = StreamSummaryEngine(edge_bucket=eb,
+                               vertex_bucket=vb).process(s, d)
+
+    eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    head = eng.process(s[:3 * eb], d[:3 * eb])
+    state = eng.state_dict()
+
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    co.admit("migrated")
+    co.load_tenant_state_dict("migrated", state)
+    off = co.resume_offset("migrated")
+    assert off == 3 * eb
+    co.feed("migrated", s[off:], d[off:])
+    tail = co.pump().get("migrated", [])
+    tail.extend(co.close("migrated"))
+    assert head + tail == full
+
+
 def test_sharded_engine_state_roundtrip_through_file(tmp_path):
     """ShardedWindowEngine state through the npz format (skipped when
     this jax build cannot run while_loops under shard_map — the

@@ -25,6 +25,8 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_TRACE_DURABLE GS_METRICS GS_METRICS_PORT "
        "GS_METRICS_SERIES GS_METRICS_COMPILE_BASE "
        "GS_HEALTH_STALE_S "
+       "GS_TENANT_MAX GS_TENANT_QUEUE_WINDOWS GS_TENANT_ADMISSION "
+       "GS_TENANT_TPD "
        "GS_COSTMODEL GS_COSTMODEL_PEAK_GFLOPS "
        "GS_COSTMODEL_PEAK_GBPS").split()
 

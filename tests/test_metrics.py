@@ -411,6 +411,59 @@ def test_mark_window_drives_throughput_and_age(armed):
     assert snap["engines"]["driver"]["windows"] == 8
 
 
+def test_mark_window_tenant_rows_and_staleness(armed, monkeypatch):
+    """Per-tenant finalize marks drive the /healthz `tenants` section:
+    window/edge counters, per-tenant last-finalize age, and a per-row
+    stale flag once GS_HEALTH_STALE_S passes without THAT tenant
+    finalizing (the cohort stays ok while one stream wedges)."""
+    monkeypatch.setenv("GS_HEALTH_STALE_S", "5")
+    metrics.on_stream_start("cohort", tenant="t1")
+    metrics.mark_window(2, 1024, engine="cohort", tier="cohort",
+                        tenant="t1", now=10.0)
+    metrics.mark_window(1, 512, engine="cohort", tier="cohort",
+                        tenant="t2", now=11.0)
+    metrics.mark_window(1, 512, engine="cohort", tier="cohort",
+                        tenant="t2", now=18.0)
+    snap = metrics.health_snapshot(now=19.0)
+    t1, t2 = snap["tenants"]["t1"], snap["tenants"]["t2"]
+    assert (t1["windows"], t1["edges"]) == (2, 1024)
+    assert (t2["windows"], t2["edges"]) == (2, 1024)
+    assert t1["last_finalize_age_s"] == 9.0 and t1["stale"] is True
+    assert t2["last_finalize_age_s"] == 1.0 and t2["stale"] is False
+    # tenant-labeled counters ride the normal registry
+    c = metrics.counters()
+    assert c[("gs_tenant_windows_total",
+              (("tenant", "t1"), ("tier", "cohort")))] == 2
+    assert c[("gs_windows_finalized_total",
+              (("engine", "cohort"), ("tenant", "t2"),
+               ("tier", "cohort")))] == 2
+
+
+def test_tenant_table_cardinality_bound(armed, monkeypatch):
+    """The per-tenant /healthz table obeys the SAME cardinality bound
+    as label sets: past GS_METRICS_SERIES new tenants collapse into
+    one `overflow` row (counted once each in dropped_series), so a
+    tenant-shaped label can never grow the registry unboundedly."""
+    monkeypatch.setenv("GS_METRICS_SERIES", "4")
+    for i in range(10):
+        metrics.mark_tenant("t%d" % i, 1, 100, tier="cohort")
+    snap = metrics.health_snapshot(now=1.0)
+    assert len(snap["tenants"]) == 5  # 4 admitted + overflow
+    assert snap["tenants"]["overflow"]["windows"] == 6
+    # recurring marks on a collapsed tenant accumulate in overflow
+    # without inflating the dropped counter past one per DISTINCT id
+    metrics.mark_tenant("t9", 1, 100)
+    metrics.mark_tenant("t9", 1, 100)
+    snap = metrics.health_snapshot(now=1.0)
+    assert snap["tenants"]["overflow"]["windows"] == 8
+    assert "gs_metrics_dropped_series_total" in \
+        metrics.render_prometheus()
+    # admitted tenants keep their own rows past the bound
+    metrics.mark_tenant("t0", 3, 100)
+    assert metrics.health_snapshot(
+        now=1.0)["tenants"]["t0"]["windows"] == 4
+
+
 def test_sample_memory_reports_live_buffers(armed):
     import jax.numpy as jnp
 

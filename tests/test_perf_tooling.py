@@ -120,6 +120,12 @@ FIXTURE = {
                    "full_edges_per_s": 1, "delta_edges_per_s": 2,
                    "speedup": 2.0, "speedup_worst": 1.9,
                    "speedup_best": 2.1}],
+    "tenancy_ab": [{"probe": "cohort_serving", "parity": True,
+                    "tenants": 8, "eb": 512, "vb": 1024,
+                    "tenant_edges_per_s": 18476,
+                    "sequential_edges_per_s": 12285,
+                    "speedup": 1.504, "speedup_worst": 1.346,
+                    "speedup_best": 1.584}],
     "autotune": [{"engine": "triangle_stream", "edge_bucket": 32768,
                   "parity": True, "static_edges_per_s": 1,
                   "tuned_cold_edges_per_s": 2,
@@ -187,7 +193,8 @@ def test_render_covers_every_new_section():
                    "Flight recorder", "ingress.prep", "1.010",
                    "Metrics plane", "1.021",
                    "Program cost observatory", "fused_scan",
-                   "explain_perf"):
+                   "explain_perf",
+                   "Multi-tenant cohort A/B", "cohort_serving"):
         assert needle in block, needle
 
 
@@ -336,6 +343,44 @@ def test_bench_compare_ratio_field_and_tolerance(tmp_path):
     assert bench_compare.main(
         ["--baseline", base, "--current", cur,
          "--tolerance", "0.1"]) == 1
+
+
+def test_schema_and_sentry_cover_tenancy_rows(tmp_path):
+    """The tenancy_ab section: required keys enforced (probe / parity
+    / tenants; parity-true rows need a positive speedup), and
+    bench_compare matches tenancy rows by (probe, tenants) identity
+    comparing tenant_edges_per_s — the regression sentry covers the
+    cohort path."""
+    bad = {"backend": "cpu",
+           "tenancy_ab": [{"probe": "cohort_serving", "parity": True}]}
+    errors = "\n".join(perf_schema.validate(bad))
+    assert "tenancy_ab" in errors
+    assert "'tenants'" in errors and "speedup" in errors
+    good = {"backend": "cpu",
+            "tenancy_ab": [{"probe": "cohort_serving", "parity": True,
+                            "tenants": 8, "speedup": 1.5,
+                            "tenant_edges_per_s": 20000,
+                            "sequential_edges_per_s": 13000}]}
+    assert perf_schema.validate(good) == []
+
+    base = str(tmp_path / "PERF_base.json")
+    cur = str(tmp_path / "PERF_cur.json")
+    with open(base, "w") as f:
+        json.dump(good, f)
+    slowed = json.loads(json.dumps(good))
+    slowed["tenancy_ab"][0]["tenant_edges_per_s"] = 9000  # -55%
+    with open(cur, "w") as f:
+        json.dump(slowed, f)
+    assert bench_compare.main(
+        ["--baseline", base, "--current", base]) == 0
+    rc = bench_compare.main(
+        ["--baseline", base, "--current", cur,
+         "--out", str(tmp_path / "report.json")])
+    assert rc == 1
+    report = json.loads((tmp_path / "report.json").read_text())
+    regs = report["regressions"]
+    assert regs[0]["row"] == "tenancy_ab[cohort_serving,8]"
+    assert regs[0]["field"] == "tenant_edges_per_s"
 
 
 def test_bench_compare_reads_perf_json(tmp_path):

@@ -53,8 +53,10 @@ RATE_FIELDS = (
     "compact_edges_per_s", "full_edges_per_s", "delta_edges_per_s",
     "armed_edges_per_s", "disarmed_edges_per_s", "edges_per_s",
     "resident_edges_per_s", "perwindow_edges_per_s",
+    "tenant_edges_per_s", "sequential_edges_per_s",
 )
-RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline")
+RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline",
+                "cohort_speedup")
 
 # PERF.json sections that carry comparable rows, with the keys that
 # identify a row within the section
@@ -66,6 +68,7 @@ PERF_SECTIONS = {
     "ingress_ab": ("probe",),
     "egress_ab": ("probe",),
     "resident_ab": ("probe",),
+    "tenancy_ab": ("probe", "tenants"),
     "autotune": ("engine", "edge_bucket"),
 }
 

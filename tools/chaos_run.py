@@ -370,6 +370,151 @@ def leg_resident(path: str, eb: int, num_w: int, workdir: str) -> dict:
                 os.environ[k] = v
 
 
+def leg_tenancy(workdir: str) -> dict:
+    """The multi-tenant drill (core/tenancy.py): N tenants through
+    the vmapped cohort with per-tenant auto-checkpoints, taking
+
+      · ONE tenant's slab prep poisoned mid-cohort (injected
+        `tenant_prep` raise) → that tenant demotes ALONE to its
+        single-tenant engine (utils/resilience records it with the
+        tenant label) while the cohort keeps dispatching the others
+      · a FATAL kill mid-dispatch (`cohort_dispatch`) → a fresh
+        cohort resumes every tenant from its OWN checkpoint
+        (resume_all) and re-feeds from each resume_offset
+
+    and the final per-tenant summary stream must be BIT-IDENTICAL to
+    the fault-free sequential single-tenant oracle — single-tenant
+    fault isolation AND per-tenant kill→resume, on one schedule."""
+    import numpy as np
+
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    # like the autotune/resident legs: this leg proves isolation and
+    # kill→resume, not the watchdog (leg A owns that) — the chaos 1 s
+    # deadline would cut the cohort program's cold compile under load
+    env_prev = os.environ.get("GS_STAGE_TIMEOUT_S")
+    os.environ["GS_STAGE_TIMEOUT_S"] = "30"
+    try:
+        return _leg_tenancy_body(workdir, np, TenantCohort)
+    finally:
+        if env_prev is None:
+            os.environ.pop("GS_STAGE_TIMEOUT_S", None)
+        else:
+            os.environ["GS_STAGE_TIMEOUT_S"] = env_prev
+
+
+def _leg_tenancy_body(workdir: str, np, TenantCohort) -> dict:
+    eb, vb, n_tenants, num_w = 512, 1024, 4, 8
+    streams = {}
+    for i in range(n_tenants):
+        n = num_w * eb - (eb // 3 if i == 3 else 0)
+        s, d = make_stream(n, vb, seed=40 + i)
+        streams["t%d" % i] = (s.astype(np.int32), d.astype(np.int32))
+
+    # fault-free oracle: N sequential single-tenant engines
+    oracle = {}
+    for tid, (s, d) in streams.items():
+        oracle[tid] = StreamSummaryEngine(
+            edge_bucket=eb, vertex_bucket=vb).process(s, d)
+
+    ckdir = os.path.join(workdir, "tenants")
+
+    def make():
+        co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        for tid in streams:
+            co.admit(tid)
+        co.enable_auto_checkpoint(ckdir, every_n_windows=2)
+        return co
+
+    co = make()
+    got = {tid: [] for tid in streams}
+    cursors = {tid: 0 for tid in streams}
+    fired = []
+    killed = False
+    # tenant_prep fires once per tenant per round (sorted tids):
+    # on_call=6 is round 2, tenant index 1 → "t1" demotes; the fatal
+    # cohort_dispatch on_call=4 kills round 4's vmapped dispatch
+    plan_specs = [
+        faults.FaultSpec(site="tenant_prep", on_call=6),
+        faults.FaultSpec(site="cohort_dispatch", on_call=4,
+                         fatal=True),
+    ]
+    try:
+        with faults.inject(*plan_specs) as plan:
+            live = True
+            while live:
+                live = False
+                for tid, (s, d) in streams.items():
+                    c = cursors[tid]
+                    if c >= len(s):
+                        continue
+                    co.feed(tid, s[c:c + eb], d[c:c + eb])
+                    cursors[tid] = min(len(s), c + eb)
+                    live = True
+                for tid, res in co.pump().items():
+                    got[tid].extend(res)
+    except faults.InjectedFault:
+        killed = True
+        fired = list(plan.fired)
+    if not killed:
+        raise SystemExit("chaos tenancy leg: the kill never fired "
+                         "(fired=%r)" % (plan.fired,))
+    demoted = [tid for tid in streams
+               if co.tenant_tier(tid) == "single"]
+    if demoted != ["t1"]:
+        raise SystemExit("chaos tenancy leg: expected exactly t1 "
+                         "demoted before the kill, got %r" % demoted)
+    tenant_demotions = [e for e in resilience.demotion_events()
+                        if e.get("tenant") == "t1"
+                        and e["from"] == "cohort"
+                        and e["to"] == "single"]
+    if not tenant_demotions:
+        raise SystemExit("chaos tenancy leg: no tenant-labeled "
+                         "demotion event was recorded")
+
+    # the simulated process death: a FRESH cohort resumes every
+    # tenant from its own checkpoint and re-feeds from its offset
+    co2 = make()
+    resumed = co2.resume_all()
+    if not any(resumed.values()):
+        raise SystemExit("chaos tenancy leg: no tenant had a "
+                         "resumable checkpoint after the kill")
+    final = {}
+    for tid, (s, d) in streams.items():
+        off = co2.resume_offset(tid)
+        r = off // eb
+        if len(got[tid]) < r:
+            raise SystemExit(
+                "chaos tenancy leg: tenant %s checkpoint covers %d "
+                "windows but only %d were delivered pre-kill — the "
+                "staged-checkpoint delivery contract broke" %
+                (tid, r, len(got[tid])))
+        final[tid] = got[tid][:r]
+        c = off
+        while c < len(s):
+            co2.feed(tid, s[c:c + 2 * eb], d[c:c + 2 * eb])
+            c = min(len(s), c + 2 * eb)
+            for t2, res in co2.pump().items():
+                if t2 == tid:
+                    final[tid].extend(res)
+        final[tid].extend(co2.close(tid))
+    for tid in streams:
+        if final[tid] != oracle[tid]:
+            raise SystemExit(
+                "chaos tenancy leg DIVERGED from the fault-free "
+                "sequential oracle for tenant %s (%d vs %d windows)"
+                % (tid, len(final[tid]), len(oracle[tid])))
+    return {
+        "tenants": n_tenants,
+        "windows_per_tenant": num_w,
+        "demoted_tenant": "t1",
+        "resumed": {tid: bool(v) for tid, v in sorted(
+            resumed.items())},
+        "faults_fired": [list(f) for f in fired],
+        "parity": True,
+    }
+
+
 def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
              workdir: str) -> dict:
     """The mesh drill: a sharded driver on the virtual CPU mesh takes
@@ -772,14 +917,20 @@ def main():
             # h2d, recovers after the retry, durable events + armed
             # digest parity
             h = leg_health(workdir)
+            # tenancy leg: one tenant's prep poisoned mid-cohort →
+            # isolated demotion; fatal kill mid-dispatch → per-tenant
+            # checkpoint resume; per-tenant digests equal the
+            # fault-free sequential oracle
+            tn = leg_tenancy(workdir)
             # mesh leg: corrupt wire → retry, dead shard → demotion →
             # parity, n-shard checkpoint → 1-device + host-twin resume
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
                           args.mesh_devices, workdir)
                  if args.mesh_devices else None)
-            # flight-recorder leg: four kills fired above (driver,
-            # autotune, resident, engine) — the ledger must prove all
-            fr = assert_flight_recorder(num_kills=4)
+            # flight-recorder leg: five kills fired above (driver,
+            # autotune, resident, engine, tenancy) — the ledger must
+            # prove all
+            fr = assert_flight_recorder(num_kills=5)
             fr["span_summary"] = telemetry.summary(top=12)
         finally:
             telemetry.reset()  # close the ledger inside the tempdir
@@ -803,6 +954,12 @@ def main():
         if site == "dispatch" and action == "raise":
             classes.add("resident_kill_resume")
     required.add("resident_kill_resume")
+    for site, _n, action in tn["faults_fired"]:
+        if site == "tenant_prep" and action == "raise":
+            classes.add("tenant_demotion")
+        elif site == "cohort_dispatch" and action == "raise":
+            classes.add("tenant_kill_resume")
+    required |= {"tenant_demotion", "tenant_kill_resume"}
     if m is not None:
         for site, _n, action in m["faults_fired"]:
             if action == "corrupt_shard":
@@ -829,6 +986,7 @@ def main():
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
         "resident_leg": rs,
         "health_leg": h,
+        "tenancy_leg": tn,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
         "gslint_leg": gl,

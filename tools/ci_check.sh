@@ -10,6 +10,10 @@
 #   4. bench_compare --baseline BENCH_r05.json self-compare (the
 #      regression sentry's wiring smoke: must exit 0 on an unchanged
 #      baseline)
+#   5. tenancy parity smoke (tools/tenancy_ab.py --smoke): a 1-tenant
+#      cohort must be digest-identical to the single-stream engine,
+#      so the vmapped cohort path can't silently drift from the
+#      single-stream semantics
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -18,21 +22,24 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/4] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/5] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/4] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/5] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/4] gslint =="
+echo "== [2/5] gslint =="
 python -m tools.gslint
 
-echo "== [3/4] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/5] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/4] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/5] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
+
+echo "== [5/5] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
 echo "ci_check: all gates green"

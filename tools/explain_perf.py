@@ -176,6 +176,49 @@ def program_attribution(records, cost_rows):
     return rows
 
 
+def tenant_attribution(records):
+    """Per-tenant measured seconds over spans that carry the tenant
+    label (the cohort's `tenant.single` dispatches, a tenant-labeled
+    driver's steps), plus one `<cohort>` row aggregating the vmapped
+    `cohort.dispatch` spans — whose time is SHARED by all tenants in
+    the slab, so it is reported with its mean tenants-per-dispatch
+    instead of being split by guesswork. Unlike the stage taxonomy,
+    this table reads ALL spans (not just leaves): a tenant-labeled
+    span legitimately envelopes its engine's internal chunk spans —
+    its duration IS the tenant's wall time, and the table is rendered
+    beside (never summed into) the conservation-checked stage totals.
+    Empty ledger → empty list (the section only renders when a
+    multi-tenant run produced it)."""
+    per = {}
+    cohort = {"count": 0, "total_s": 0.0, "tenants": 0, "edges": 0}
+    for rec in (r for r in records if r.get("t") == "span"):
+        a = rec.get("a") or {}
+        if a.get("tenant") is not None:
+            t = per.setdefault(str(a["tenant"]),
+                               {"count": 0, "total_s": 0.0,
+                                "edges": 0})
+            t["count"] += 1
+            t["total_s"] += float(rec.get("dur", 0.0))
+            t["edges"] += int(a.get("edges") or 0)
+        elif rec.get("name") == "cohort.dispatch":
+            cohort["count"] += 1
+            cohort["total_s"] += float(rec.get("dur", 0.0))
+            cohort["tenants"] += int(a.get("tenants") or 0)
+            cohort["edges"] += int(a.get("edges") or 0)
+    rows = [dict(tenant=tid, count=t["count"],
+                 total_s=round(t["total_s"], 6), edges=t["edges"])
+            for tid, t in sorted(per.items())]
+    if cohort["count"]:
+        rows.append({
+            "tenant": "<cohort>", "count": cohort["count"],
+            "total_s": round(cohort["total_s"], 6),
+            "edges": cohort["edges"],
+            "mean_tenants_per_dispatch": round(
+                cohort["tenants"] / cohort["count"], 2)})
+    rows.sort(key=lambda r: -r["total_s"])
+    return rows
+
+
 def rank_suspects(stage_rows, prog_rows, records):
     """Deterministic heuristics → ranked suspect list, each with a
     score in [0, 1] and the evidence line an operator acts on."""
@@ -303,6 +346,18 @@ def render(report, top=0):
                        r.get("roofline_frac"),
                        r.get("achieved_gflops")))
         lines.append("")
+    if report.get("tenants"):
+        lines.append("tenant attribution (tenant-labeled spans; "
+                     "<cohort> rows are shared vmapped dispatches):")
+        for r in report["tenants"]:
+            extra = ("  tenants/dispatch=%s"
+                     % r["mean_tenants_per_dispatch"]
+                     if "mean_tenants_per_dispatch" in r else "")
+            lines.append("  %-16s spans=%-5d total_s=%-10.4f "
+                         "edges=%d%s" % (r["tenant"], r["count"],
+                                         r["total_s"], r["edges"],
+                                         extra))
+        lines.append("")
     if report["suspects"]:
         lines.append("ranked suspects:")
         for i, s in enumerate(report["suspects"], 1):
@@ -394,6 +449,7 @@ def main(argv=None) -> int:
     mapped_frac = (1.0 - other_s / ledger_total if ledger_total > 0
                    else 1.0)
     programs = program_attribution(records, cost_rows)
+    tenants = tenant_attribution(records)
     suspects = rank_suspects(stages, programs, records)
     report = {
         "trace": trace_report.meta_of(records).get("trace"),
@@ -407,6 +463,7 @@ def main(argv=None) -> int:
         "unmapped_spans": unmapped,
         "stages": stages,
         "programs": programs,
+        "tenants": tenants,
         "suspects": suspects,
     }
     if regression is not None:
