@@ -48,6 +48,7 @@ from ..utils import faults
 from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
+from ..utils import wal as wal_mod
 from ..utils.interning import make_interner, parallel_intern_arrays
 from ..utils.tracing import StepTimer
 
@@ -358,6 +359,15 @@ class StreamingAnalyticsDriver:
         self._ckpt_policy = None  # utils.checkpoint.CheckpointPolicy
         self._pending_ckpt = []  # staged (windows_done, state) — see
         self._emitted = None     # _stage_ckpt; not-None inside stream_file
+        # write-ahead edge journal (utils/wal.py): armed by
+        # enable_wal(), appended to in run_arrays BEFORE windowing —
+        # the durable source the live (non-file) feed path never had.
+        # _in_stream suppresses journaling under stream_file: a
+        # file-backed source is already replayable, and journaling it
+        # would double-replay on recovery.
+        self._wal = None
+        self._wal_dir = None
+        self._in_stream = 0
         # tier demotion (utils/resilience): a persistent device failure
         # in the batched snapshot path demotes scan→native→host
         # mid-stream instead of killing the job; None = not demoted
@@ -547,10 +557,22 @@ class StreamingAnalyticsDriver:
         computed-but-never-delivered ones."""
         from ..io.sources import iter_edge_chunks
 
+        if self._wal is not None:
+            # wal_offset is DEFINED as edges_done, and stream_file
+            # edges are deliberately never journaled (the file is its
+            # own journal) — mixing the two sources would advance the
+            # cursor past journaled live edges and make recovery skip
+            # them. One driver, one source model.
+            raise ValueError(
+                "stream_file() on a journal-armed driver would skew "
+                "the wal_offset/edges_done contract: use run_arrays "
+                "(journaled live feed) OR file streaming, not both "
+                "on one driver")
         to_skip = self.edges_done if resume else 0
         pend = (np.zeros(0, np.int64),) * 3
         timestamped = None
         self._emitted = self.windows_done
+        self._in_stream += 1  # file source: already replayable, no WAL
         try:
             for src, dst, ts in iter_edge_chunks(path, chunk_bytes):
                 if to_skip:
@@ -592,6 +614,7 @@ class StreamingAnalyticsDriver:
             # (the last FLUSHED checkpoint stays ≤ what was delivered)
             self._pending_ckpt = []
             self._emitted = None
+            self._in_stream -= 1
 
     def run_arrays(self, src: np.ndarray, dst: np.ndarray,
                    ts: Optional[np.ndarray] = None,
@@ -604,6 +627,22 @@ class StreamingAnalyticsDriver:
         metrics.on_stream_start("driver", tenant=self.tenant)
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
+
+        def _journal(ts_arr=None):
+            # durability boundary of the LIVE feed path: journal
+            # (with timestamps when event-time) AFTER validation —
+            # a rejected batch must leave no journal record, or the
+            # journal offsets skew against edges_done and replay
+            # re-raises the rejection — and BEFORE any window is cut,
+            # so a kill past this point is recoverable by
+            # resume_and_replay(). stream_file never journals: its
+            # file is its own journal (and enable_wal refuses the
+            # mixed-source mode outright).
+            if self._wal is not None and len(src) \
+                    and not self._in_stream:
+                self._wal.append(self.tenant or "driver", src, dst,
+                                 ts_arr)
+                faults.fire("wal_enqueue", self.tenant or "driver")
         if _starts is not None or (
                 ts is not None and len(ts) and int(np.max(ts)) >= 0):
             if _starts is not None:
@@ -625,6 +664,9 @@ class StreamingAnalyticsDriver:
             slices = np.split(np.arange(len(src)), bounds)
             windows = [(int(starts[idx[0]]), src[idx], dst[idx])
                        for idx in slices if len(idx)]
+            _journal(np.asarray(ts, np.int64)
+                     if ts is not None and len(np.atleast_1d(ts))
+                     else None)
             return self._dispatch_windows(windows)
         # count-based: window_start = absolute stream offset; the
         # edges_done cursor advances per window (inside _window, so
@@ -638,6 +680,7 @@ class StreamingAnalyticsDriver:
                 "a previous count-based run closed a partial window "
                 "(length not a multiple of edge_bucket); chunked "
                 "count-based feeding must use edge_bucket multiples")
+        _journal()
         windows = []
         at = self.edges_done
         for i in range(0, len(src), self.eb):
@@ -2201,6 +2244,62 @@ class StreamingAnalyticsDriver:
                         path=used, windows_done=self.windows_done)
         return True
 
+    def enable_wal(self, directory: str) -> bool:
+        """Journal every LIVE run_arrays() feed under `directory`
+        (utils/wal.py) before any window is cut — the durable,
+        replayable source the file path already is and the live path
+        never was. After a kill, `resume_and_replay(ckpt)` restores
+        the newest checkpoint and re-feeds the journal suffix past
+        its `wal_offset`, reproducing the lost windows bit-exactly.
+        A journal-armed driver REFUSES stream_file() (the file is its
+        own journal; mixing the sources would skew the offset
+        contract). Returns False (a no-op) under GS_WAL=0."""
+        if not wal_mod.enabled():
+            return False
+        self._wal_dir = directory
+        self._wal = wal_mod.WriteAheadLog(directory)
+        return True
+
+    def seal_wal(self) -> None:
+        """Durably close the journal (the clean-drain marker)."""
+        if self._wal is not None:
+            self._wal.seal()
+
+    def resume_and_replay(self, ckpt_path: str) -> List[WindowResult]:
+        """Kill recovery for a journal-armed driver: try_resume the
+        newest checkpoint generation, then replay the journal suffix
+        past the checkpointed `wal_offset` through run_arrays().
+        Returns the replayed WindowResults — every window the crashed
+        process computed (or had accepted) but never delivered."""
+        self.try_resume(ckpt_path)
+        if self._wal_dir is None:
+            return []
+        tenant = self.tenant or "driver"
+        off = self.edges_done
+        parts = []
+        for tid, _start, src, dst, ts in wal_mod.replay(
+                self._wal_dir, {tenant: off}):
+            if tid == tenant:
+                parts.append((src, dst, ts))
+        edges = sum(len(p[0]) for p in parts)
+        telemetry.event("wal_replayed", durable=True,
+                        component="driver", dir=self._wal_dir,
+                        edges=edges)
+        metrics.counter_inc("gs_wal_replayed_edges_total", edges)
+        if not edges:
+            return []
+        src = np.concatenate([p[0] for p in parts])
+        dst = np.concatenate([p[1] for p in parts])
+        ts = (np.concatenate([p[2] for p in parts])
+              if all(p[2] is not None for p in parts) else None)
+        # suspend journaling for the replay feed: these edges are
+        # already in the journal
+        live, self._wal = self._wal, None
+        try:
+            return self.run_arrays(src, dst, ts)
+        finally:
+            self._wal = live
+
     def state_dict(self) -> dict:
         state = {
             "window_ms": self.window_ms,
@@ -2209,6 +2308,11 @@ class StreamingAnalyticsDriver:
             "mesh_shape": self._mesh_shape(),  # gslint: disable=ckpt-symmetry (provenance: load converts cross-mesh, never needs the source shape back)
             "windows_done": self.windows_done,
             "edges_done": self.edges_done,
+            # journal offset at this finalized-window boundary (the
+            # edges_done cursor IS the cumulative live-feed edge
+            # count): resume_and_replay() re-feeds the WAL strictly
+            # past it (DESIGN.md §18)
+            "wal_offset": self.edges_done,
             "edge_bucket": self.eb,
             "vertex_bucket": self.vb,
             "closed_partial": self._closed_partial,
@@ -2247,6 +2351,14 @@ class StreamingAnalyticsDriver:
         self._ext_ids = np.zeros(0, np.int64)
         self.windows_done = int(state.get("windows_done", 0))
         self.edges_done = int(state.get("edges_done", 0))
+        woff = state.get("wal_offset")
+        if woff is not None and int(woff) != self.edges_done:
+            # the journal offset is DEFINED as the folded-edge cursor;
+            # divergence means a hand-edited checkpoint that would
+            # replay a hole or a double-fold — refuse loudly
+            raise ValueError(
+                "checkpoint wal_offset %d disagrees with its own "
+                "edges_done cursor %d" % (int(woff), self.edges_done))
         # persist the misuse guard: a checkpoint taken after a partial
         # count-based window must refuse further unaligned feeding just
         # like the live driver would

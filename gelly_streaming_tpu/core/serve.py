@@ -1,0 +1,680 @@
+"""Durable async serving front-end: live traffic in, windows out,
+survives being killed mid-window.
+
+`StreamServer` wraps a multi-tenant cohort (core/tenancy.TenantCohort)
+with the pieces a deployable stream service needs and the ROADMAP's
+"async serving front-end" item names:
+
+- **Sources.** A 127.0.0.1 TCP accept loop speaking newline-delimited
+  JSON requests (admit / feed / pump / close / status — see _OPS), and
+  `attach_file_tail()` threads that follow growing edge files
+  (io/sources.tail_edge_file). Both feed the SAME guarded
+  `cohort.feed()` admission path, so every accepted edge hits the
+  write-ahead journal (utils/wal.py) before any queue.
+- **Typed wire responses.** `TenantRejected` / `TenantBackpressure`
+  come back as `{"ok": false, "error": <type>, ...}` with a
+  deterministic `retry_after_s` hint (utils/resilience.backoff_s —
+  the SAME GS_STAGE_BACKOFF_S ladder the in-process stage guard
+  sleeps, doubling per consecutive rejection and resetting on the
+  first accepted feed), so a polite client and the internal retry
+  pace identically.
+- **Deadlines.** Per-connection idle timeout (GS_SERVE_IDLE_S) on the
+  receive side; every response send runs under
+  `resilience.call_guarded` with the same deadline and retries=0 — a
+  slow client that stops reading is SHED (durable `serve_client_shed`
+  event, connection closed) instead of wedging the thread that also
+  pumps. The accept backlog is bounded (listen backlog + a
+  max-connections cap answered with a typed `ServerBusy`).
+- **Graceful drain.** SIGTERM (or `request_drain()`): stop accepting,
+  finish in-flight requests under GS_SERVE_DRAIN_S, stop the tails,
+  pump every tenant queue dry, flush a checkpoint per tenant, SEAL
+  the journal (durable `wal_sealed` + `serve_drain` events), exit 0 —
+  zero queued windows lost, proven by the drain leg of
+  tools/chaos_run.py (drain digest ≡ keep-running digest).
+- **Recovery.** A killed server restarts with `--recover` /
+  `recover()`: tenants are re-admitted from the journal, each resumes
+  its newest checkpoint, and the un-checkpointed journal suffix
+  replays into the queues — the next pumps re-produce exactly the
+  windows the crash swallowed (`wal_replayed` durable event;
+  replay-exactness asserted by the chaos serve leg and
+  tests/test_checkpoint_roundtrip.py).
+- **Observability.** `gs_serve_*` counters/gauges, a `serve` section
+  on `/healthz` (metrics.register_health_section), and durable ledger
+  events for drain/seal/replay/shed.
+
+Run one standalone:
+
+    python -m gelly_streaming_tpu.core.serve --edge-bucket 512 \
+        --vertex-bucket 1024 --wal wal/ --ckpt ckpt/ \
+        --results results.jsonl [--recover] [--port-file port.txt]
+
+The process prints its bound port, pumps continuously, appends every
+finalized window summary to the results file as one JSON line
+(tenant, window ordinal, summary — at-least-once across a
+kill/recover: consumers keep the LAST record per (tenant, window)),
+and exits 0 on SIGTERM after a clean drain.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..utils import knobs
+from ..utils import metrics
+from ..utils import resilience
+from ..utils import telemetry
+from ..utils.faults import InjectedFault
+from .tenancy import TenantBackpressure, TenantCohort, TenantRejected
+
+_OPS = ("admit", "feed", "pump", "close", "status")
+
+
+def serve_port() -> int:
+    """GS_SERVE_PORT (0 = OS-assigned ephemeral; `.port` holds the
+    bound one)."""
+    return knobs.get_int("GS_SERVE_PORT")
+
+
+def drain_deadline_s() -> float:
+    """GS_SERVE_DRAIN_S: how long drain waits for in-flight requests
+    before force-closing their connections (0 = forever)."""
+    return knobs.get_float("GS_SERVE_DRAIN_S")
+
+
+def idle_timeout_s() -> float:
+    """GS_SERVE_IDLE_S: per-connection receive-idle AND response-send
+    deadline."""
+    return knobs.get_float("GS_SERVE_IDLE_S")
+
+
+class StreamServer:
+    """One cohort behind one accept loop. All cohort access is
+    serialized by `_lock` (the cohort is not thread-safe); response
+    sends happen OUTSIDE it, so a slow client can stall only its own
+    connection thread — never the pump."""
+
+    def __init__(self, cohort: TenantCohort,
+                 host: str = "127.0.0.1",
+                 port: Optional[int] = None,
+                 backlog: int = 16,
+                 max_connections: int = 32,
+                 results_path: Optional[str] = None):
+        self.cohort = cohort
+        self._lock = threading.RLock()
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, serve_port()
+                             if port is None else port))
+        self._listener.listen(backlog)
+        self.host = host
+        self.port = self._listener.getsockname()[1]
+        self.max_connections = max_connections
+        self._accept_thread = None
+        self._conns: Dict[int, socket.socket] = {}
+        self._conn_threads: List[threading.Thread] = []
+        self._conn_seq = 0
+        self._draining = threading.Event()
+        self._drain_req = threading.Event()
+        self._drained = None          # drain() summary, once run
+        self._drain_lock = threading.Lock()
+        self.fatal = False            # a fatal InjectedFault landed
+        self._bp_attempts: Dict[str, int] = {}  # consecutive rejects
+        self._tails: List[tuple] = []  # (thread, stop_event)
+        self._results_path = results_path
+        self._results_file = (open(results_path, "a")
+                              if results_path else None)
+        self.results: Dict[str, list] = {}  # tenant -> summaries
+        self._stats = {"connections": 0, "requests": 0, "shed": 0,
+                       "rejections": 0, "busy": 0, "windows": 0}
+        metrics.register_health_section("serve", self._health_section)
+        telemetry.event("serve_started", port=self.port)
+
+    # ------------------------------------------------------------------
+    # accept loop
+    # ------------------------------------------------------------------
+    def start(self) -> "StreamServer":
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="gs-serve")
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        while not self._draining.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed: drain began
+            with self._lock:
+                self._stats["connections"] += 1
+                active = len(self._conns)
+            metrics.counter_inc("gs_serve_connections_total")
+            if active >= self.max_connections:
+                # bounded backlog: answer with a typed busy + the
+                # deterministic retry hint, never queue unboundedly
+                self._stats["busy"] += 1
+                metrics.counter_inc("gs_serve_rejections_total",
+                                    kind="ServerBusy")
+                try:
+                    conn.sendall((json.dumps({
+                        "ok": False, "error": "ServerBusy",
+                        "retry_after_s": resilience.backoff_s(0),
+                    }) + "\n").encode())
+                except OSError:
+                    pass
+                conn.close()
+                continue
+            with self._lock:
+                self._conn_seq += 1
+                cid = self._conn_seq
+                self._conns[cid] = conn
+            t = threading.Thread(target=self._handle_conn,
+                                 args=(cid, conn), daemon=True,
+                                 name="gs-serve-conn-%d" % cid)
+            # prune finished threads: only the accept thread touches
+            # this list, and without the filter a long-lived server
+            # would keep every dead connection thread forever (and
+            # drain() would join the whole graveyard)
+            self._conn_threads = [x for x in self._conn_threads
+                                  if x.is_alive()]
+            self._conn_threads.append(t)
+            t.start()
+
+    def _handle_conn(self, cid: int, conn: socket.socket) -> None:
+        gauge = len(self._conns)
+        metrics.gauge_set("gs_serve_active_connections", gauge)
+        conn.settimeout(idle_timeout_s())
+        buf = b""
+        try:
+            while not self._draining.is_set():
+                nl = buf.find(b"\n")
+                if nl < 0:
+                    try:
+                        chunk = conn.recv(1 << 20)
+                    except socket.timeout:
+                        telemetry.event("serve_idle_closed", conn=cid)
+                        metrics.counter_inc(
+                            "gs_serve_idle_closed_total")
+                        return
+                    if not chunk:
+                        return  # client hung up
+                    buf += chunk
+                    continue
+                line, buf = buf[:nl], buf[nl + 1:]
+                if not line.strip():
+                    continue
+                resp = self._handle_request(cid, line)
+                if not self._send(cid, conn, resp):
+                    return
+        except InjectedFault as e:
+            if e.fatal:
+                # the simulated hard kill: the whole server is dead —
+                # close the listener so no further accept succeeds,
+                # exactly the shape a real SIGKILL leaves behind
+                self.fatal = True
+                try:
+                    self._listener.close()
+                except OSError:
+                    pass
+                raise
+            telemetry.event("serve_request_failed", conn=cid,
+                            error=repr(e)[:200])
+        except OSError:
+            return  # connection reset: the client's problem
+        finally:
+            with self._lock:
+                self._conns.pop(cid, None)
+            metrics.gauge_set("gs_serve_active_connections",
+                              len(self._conns))
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _send(self, cid: int, conn: socket.socket,
+              resp: dict) -> bool:
+        """Send one response under the per-connection deadline: a
+        client that stops reading long enough to stall the send is
+        SHED (durable event + close) — the stall never reaches the
+        pump, whose lock is not held here."""
+        data = (json.dumps(resp) + "\n").encode()
+
+        def _do_send():
+            from ..utils import faults
+
+            faults.fire("serve_send", cid)
+            conn.sendall(data)
+
+        try:
+            resilience.call_guarded("serve_send", cid, _do_send,
+                                    retries=0,
+                                    timeout=idle_timeout_s())
+            return True
+        except (resilience.StageError, OSError):
+            self._stats["shed"] += 1
+            telemetry.event("serve_client_shed", durable=True,
+                            conn=cid, bytes=len(data))
+            metrics.counter_inc("gs_serve_shed_total")
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return False
+
+    # ------------------------------------------------------------------
+    # requests
+    # ------------------------------------------------------------------
+    def _handle_request(self, cid: int, line: bytes) -> dict:
+        try:
+            req = json.loads(line)
+            op = req.get("op")
+            if op not in _OPS:
+                raise ValueError("unknown op %r (one of %s)"
+                                 % (op, "/".join(_OPS)))
+        except ValueError as e:
+            return {"ok": False, "error": "BadRequest",
+                    "message": str(e)[:500]}
+        self._stats["requests"] += 1
+        metrics.counter_inc("gs_serve_requests_total", op=op)
+        try:
+            return getattr(self, "_op_" + op)(req)
+        except TenantBackpressure as e:
+            # typed backpressure with the deterministic retry hint:
+            # doubles per consecutive rejection of this tenant,
+            # resets on the first accepted feed
+            with self._lock:
+                n = self._bp_attempts.get(e.tenant, 0)
+                self._bp_attempts[e.tenant] = n + 1
+            self._stats["rejections"] += 1
+            metrics.counter_inc("gs_serve_rejections_total",
+                                kind="TenantBackpressure")
+            return {"ok": False, "error": "TenantBackpressure",
+                    "tenant": e.tenant, "queued": e.queued,
+                    "capacity": e.capacity,
+                    "retry_after_s": resilience.backoff_s(n)}
+        except TenantRejected as e:
+            self._stats["rejections"] += 1
+            metrics.counter_inc("gs_serve_rejections_total",
+                                kind="TenantRejected")
+            return {"ok": False, "error": "TenantRejected",
+                    "tenant": e.tenant, "message": str(e)[:500]}
+        except InjectedFault:
+            raise  # the chaos kill must look like a kill, not a 500
+        except (ValueError, KeyError, TypeError) as e:
+            # malformed payloads (missing tenant/src/dst, wrong
+            # shapes) must come back as the typed BadRequest the
+            # protocol promises, never kill the connection thread
+            return {"ok": False, "error": "BadRequest",
+                    "message": "%s: %s" % (type(e).__name__,
+                                           str(e)[:500])}
+
+    def _op_admit(self, req: dict) -> dict:
+        with self._lock:
+            self.cohort.admit(req["tenant"],
+                              vertex_bucket=req.get("vertex_bucket"))
+        return {"ok": True, "tenant": str(req["tenant"])}
+
+    def _op_feed(self, req: dict) -> dict:
+        src = np.asarray(req["src"], np.int32)
+        dst = np.asarray(req["dst"], np.int32)
+        with self._lock:
+            accepted = self.cohort.feed(req["tenant"], src, dst)
+            self._bp_attempts.pop(str(req["tenant"]), None)
+        return {"ok": True, "accepted": int(accepted)}
+
+    def _op_pump(self, req: dict) -> dict:
+        results = self.pump_once()
+        return {"ok": True, "results": results}
+
+    def _op_close(self, req: dict) -> dict:
+        with self._lock:
+            summaries = self.cohort.close(req["tenant"])
+            out = self._emit({str(req["tenant"]): summaries}) \
+                if summaries else {}
+        return {"ok": True,
+                "results": out.get(str(req["tenant"]), [])}
+
+    def _op_status(self, req: dict) -> dict:
+        return {"ok": True, "serve": self._health_section()}
+
+    # ------------------------------------------------------------------
+    # pumping & results
+    # ------------------------------------------------------------------
+    def pump_once(self) -> Dict[str, list]:
+        """One cohort pump under the server lock; summaries are
+        emitted to the results sink (with per-tenant window ordinals)
+        and returned keyed by tenant."""
+        with self._lock:
+            results = self.cohort.pump()
+            return self._emit(results)
+
+    def _emit(self, results: Dict[str, list]) -> Dict[str, list]:
+        out = {}
+        for tid, summaries in results.items():
+            if not summaries:
+                continue
+            base = self.cohort.windows_done(tid) - len(summaries)
+            rows = [{"tenant": tid, "window": base + i, "summary": s}
+                    for i, s in enumerate(summaries)]
+            out[tid] = rows
+            self.results.setdefault(tid, []).extend(rows)
+            self._stats["windows"] += len(rows)
+            if self._results_file is not None:
+                for row in rows:
+                    self._results_file.write(json.dumps(row) + "\n")
+                self._results_file.flush()
+        return out
+
+    def _any_ready(self) -> bool:
+        with self._lock:
+            return any(t.queued >= self.cohort.eb or
+                       (t.closing and t.queued)
+                       for t in self.cohort.tenants.values()
+                       if not t.closed)
+
+    # ------------------------------------------------------------------
+    # file-tail sources
+    # ------------------------------------------------------------------
+    def attach_file_tail(self, path: str, tenant,
+                         poll_s: float = 0.2) -> None:
+        """Follow a growing edge file into one tenant's queue through
+        the same journaled feed path the socket uses. Backpressure is
+        ridden politely (sleep the deterministic hint, retry); the
+        tail stops at drain (its final partial line flushes first)."""
+        from ..io import sources
+
+        with self._lock:
+            if str(tenant) not in self.cohort.tenants:
+                self.cohort.admit(tenant)
+        stop = threading.Event()
+
+        def _tail():
+            attempt = 0
+            for s, d, _ts in sources.tail_edge_file(
+                    path, stop, poll_s=poll_s):
+                s = np.asarray(s, np.int32)
+                d = np.asarray(d, np.int32)
+                while True:
+                    try:
+                        with self._lock:
+                            self.cohort.feed(tenant, s, d)
+                        attempt = 0
+                        break
+                    except TenantBackpressure:
+                        time.sleep(resilience.backoff_s(attempt))
+                        attempt += 1
+                        if stop.is_set():
+                            telemetry.event(
+                                "serve_tail_dropped", durable=True,
+                                tenant=str(tenant), path=path,
+                                edges=int(len(s)))
+                            return
+
+        t = threading.Thread(target=_tail, daemon=True,
+                             name="gs-serve-tail")
+        t.start()
+        self._tails.append((t, stop))
+
+    # ------------------------------------------------------------------
+    # drain & shutdown
+    # ------------------------------------------------------------------
+    def request_drain(self) -> None:
+        """Signal-safe drain request (the SIGTERM handler body);
+        `serve_until_drained()` notices and runs drain()."""
+        self._drain_req.set()
+
+    def drain(self, deadline_s: Optional[float] = None) -> dict:
+        """Graceful shutdown: stop accepting, let in-flight requests
+        finish (force-close past the deadline), stop the tails, pump
+        every queue dry, flush a checkpoint per tenant, seal the
+        journal. Idempotent; returns a summary dict."""
+        with self._drain_lock:
+            if self._drained is not None:
+                return self._drained
+            deadline = (drain_deadline_s() if deadline_s is None
+                        else deadline_s)
+            telemetry.event("serve_drain", durable=True,
+                            phase="begin", port=self.port)
+            self._draining.set()
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+            t0 = time.monotonic()
+            for t in list(self._conn_threads):
+                left = (None if deadline <= 0
+                        else max(0.0, deadline
+                                 - (time.monotonic() - t0)))
+                t.join(left)
+            forced = 0
+            with self._lock:
+                for conn in self._conns.values():
+                    forced += 1
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                self._conns.clear()
+            for t, stop in self._tails:
+                stop.set()
+            for t, _stop in self._tails:
+                t.join()
+            # pump the queues DRY: every window that was accepted is
+            # finalized and delivered to the sink before we seal
+            drained_windows = 0
+            while self._any_ready():
+                drained_windows += sum(
+                    len(v) for v in self.pump_once().values())
+            with self._lock:
+                self.cohort.checkpoint_all()
+                self.cohort.seal_wal()
+            if self._results_file is not None:
+                self._results_file.flush()
+                os.fsync(self._results_file.fileno())
+            summary = {
+                "drained_windows": drained_windows,
+                "forced_connections": forced,
+                "windows_total": self._stats["windows"],
+                "sealed": True,
+            }
+            telemetry.event("serve_drain", durable=True,
+                            phase="sealed", **summary)
+            metrics.counter_inc("gs_serve_drains_total")
+            self._drained = summary
+            return summary
+
+    def serve_until_drained(self,
+                            pump_interval_s: float = 0.02) -> dict:
+        """The standalone main loop: install the SIGTERM→drain hook,
+        pump whenever any tenant has a window ready, drain when
+        asked. Returns drain()'s summary (the caller exits 0)."""
+        import signal
+
+        def _on_term(signum, frame):
+            # flag only — drain runs on this (main) thread below, and
+            # we deliberately do NOT chain the prior handler: the
+            # whole point is to exit 0 after a clean drain, not to
+            # re-deliver a fatal SIGTERM
+            self.request_drain()
+
+        try:
+            signal.signal(signal.SIGTERM, _on_term)
+        except ValueError:
+            pass  # not the main thread: caller owns the signal story
+        if self._accept_thread is None:
+            self.start()
+        while not self._drain_req.is_set() and not self.fatal:
+            if self._any_ready():
+                self.pump_once()
+            else:
+                time.sleep(pump_interval_s)
+        return self.drain()
+
+    def close(self) -> None:
+        """Hard teardown for tests (no drain semantics)."""
+        self._draining.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        for _t, stop in self._tails:
+            stop.set()
+        with self._lock:
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        if self._results_file is not None:
+            try:
+                self._results_file.close()
+            except OSError:
+                pass
+        metrics.unregister_health_section("serve")
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def _health_section(self) -> dict:
+        with self._lock:
+            stats = dict(self._stats)
+            active = len(self._conns)
+            wal = self.cohort._wal
+        sec = {
+            "port": self.port,
+            "draining": self._draining.is_set(),
+            "active_connections": active,
+            "tails": len(self._tails),
+            **stats,
+        }
+        if wal is not None:
+            offs = wal.offsets()
+            sec["wal"] = {"tenants": len(offs),
+                          "edges": sum(offs.values()),
+                          "sealed": wal.sealed}
+        return sec
+
+
+class ServeClient:
+    """Minimal loopback client of the wire protocol (tests, the CI
+    smoke gate, tools/chaos_run.py serve leg)."""
+
+    def __init__(self, port: int, host: str = "127.0.0.1",
+                 timeout: float = 30.0):
+        self.sock = socket.create_connection((host, port),
+                                             timeout=timeout)
+        self._buf = b""
+
+    def request(self, **req) -> dict:
+        self.sock.sendall((json.dumps(req) + "\n").encode())
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl >= 0:
+                line, self._buf = self._buf[:nl], self._buf[nl + 1:]
+                return json.loads(line)
+            chunk = self.sock.recv(1 << 20)
+            if not chunk:
+                raise ConnectionError(
+                    "server closed the connection mid-request "
+                    "(killed, shed, or draining)")
+            self._buf += chunk
+
+    def admit(self, tenant, **kw) -> dict:
+        return self.request(op="admit", tenant=tenant, **kw)
+
+    def feed(self, tenant, src, dst) -> dict:
+        return self.request(op="feed", tenant=tenant,
+                            src=np.asarray(src).tolist(),
+                            dst=np.asarray(dst).tolist())
+
+    def pump(self) -> dict:
+        return self.request(op="pump")
+
+    def close_tenant(self, tenant) -> dict:
+        return self.request(op="close", tenant=tenant)
+
+    def status(self) -> dict:
+        return self.request(op="status")
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+# ----------------------------------------------------------------------
+# standalone runner
+# ----------------------------------------------------------------------
+def main(argv=None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--edge-bucket", type=int, default=512)
+    ap.add_argument("--vertex-bucket", type=int, default=1024)
+    ap.add_argument("--port", type=int, default=None,
+                    help="TCP port (default GS_SERVE_PORT; 0 = "
+                         "ephemeral)")
+    ap.add_argument("--port-file", default=None,
+                    help="write the bound port here (ephemeral-port "
+                         "coordination for harnesses)")
+    ap.add_argument("--wal", default=None,
+                    help="write-ahead journal directory (arms "
+                         "durable ingest)")
+    ap.add_argument("--ckpt", default=None,
+                    help="per-tenant checkpoint directory")
+    ap.add_argument("--ckpt-every", type=int, default=4,
+                    help="checkpoint cadence in windows")
+    ap.add_argument("--results", default=None,
+                    help="append finalized window summaries here "
+                         "(JSONL; at-least-once across recovery)")
+    ap.add_argument("--recover", action="store_true",
+                    help="resume checkpoints + replay the journal "
+                         "suffix before serving")
+    ap.add_argument("--tail", action="append", default=[],
+                    metavar="PATH:TENANT",
+                    help="file-tail source (repeatable)")
+    args = ap.parse_args(argv)
+
+    cohort = TenantCohort(edge_bucket=args.edge_bucket,
+                          vertex_bucket=args.vertex_bucket)
+    if args.wal:
+        cohort.enable_wal(args.wal)
+    if args.ckpt:
+        cohort.enable_auto_checkpoint(
+            args.ckpt, every_n_windows=args.ckpt_every)
+    if args.recover:
+        if not args.wal:
+            ap.error("--recover needs --wal")
+        info = cohort.recover()
+        print("recovered: %s" % json.dumps(
+            {k: v for k, v in info.items() if k != "resumed"}))
+    server = StreamServer(cohort, port=args.port,
+                          results_path=args.results).start()
+    print("serving on %s:%d" % (server.host, server.port),
+          flush=True)
+    if args.port_file:
+        with open(args.port_file, "w") as f:
+            f.write(str(server.port))
+    for spec in args.tail:
+        path, _, tenant = spec.rpartition(":")
+        server.attach_file_tail(path, tenant)
+    summary = server.serve_until_drained()
+    print("drained: %s" % json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

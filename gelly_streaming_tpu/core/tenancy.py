@@ -83,6 +83,7 @@ from ..utils import knobs
 from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
+from ..utils import wal as wal_mod
 
 
 # ----------------------------------------------------------------------
@@ -230,6 +231,8 @@ class TenantCohort:
         self._ckpt_every_n = 0
         self._ckpt_every_s = 0.0
         self._round_no = 0
+        self._wal = None           # utils/wal.WriteAheadLog when armed
+        self._wal_dir = None
 
     # ------------------------------------------------------------------
     # admission
@@ -337,6 +340,16 @@ class TenantCohort:
             metrics.counter_inc("gs_tenant_dropped_edges_total", shed,
                                 tenant=t.tid)
         if take:
+            if self._wal is not None:
+                # durability boundary: the accepted edges hit the
+                # journal BEFORE the queue, so a kill anywhere past
+                # this point (including between journal append and
+                # enqueue — the wal_enqueue fault site below) is
+                # recoverable by replay; a rejected feed() journals
+                # nothing, keeping replay and the caller's view of
+                # what was accepted identical
+                self._wal.append(t.tid, src[:take], dst[:take])
+                faults.fire("wal_enqueue", t.tid)
             t.src = np.concatenate([t.src, src[:take]])
             t.dst = np.concatenate([t.dst, dst[:take]])
         metrics.gauge_set("gs_tenant_queue_edges", t.queued,
@@ -719,6 +732,11 @@ class TenantCohort:
             "vertex_bucket": t.vb,
             "windows_done": int(t.windows_done),
             "closed_partial": bool(t.closed_partial),
+            # the journal offset at this finalized-window boundary
+            # (cumulative edges folded into the carry): recover()
+            # replays the WAL strictly past it — the offset/checkpoint
+            # contract of DESIGN.md §18
+            "wal_offset": int(t.windows_done) * self.eb,
             "carry": (deg, labels, cover),
         }
 
@@ -733,6 +751,14 @@ class TenantCohort:
                     t.tid, self.eb, t.vb))
         t.windows_done = int(state["windows_done"])  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
         t.closed_partial = bool(state["closed_partial"])
+        woff = state.get("wal_offset")
+        if woff is not None and int(woff) > t.windows_done * self.eb:
+            # a journal offset AHEAD of the window cursor would make
+            # recover() skip edges never folded — refuse loudly
+            raise ValueError(
+                "checkpoint wal_offset %d exceeds its own window "
+                "coverage (%d windows x eb=%d)" % (
+                    int(woff), t.windows_done, self.eb))
         t.carry = tuple(jnp.asarray(a) for a in state["carry"])
         if t.tier == "single":
             t.engine.load_state_dict(state)
@@ -791,6 +817,83 @@ class TenantCohort:
         if t.ckpt_policy.due(t.windows_done):
             t.ckpt_policy.mark(t.windows_done)
             staged.append((t, self.tenant_state_dict(t.tid)))
+
+    # ------------------------------------------------------------------
+    # write-ahead journal (utils/wal.py): durable live ingest
+    # ------------------------------------------------------------------
+    def enable_wal(self, directory: str) -> bool:
+        """Journal every accepted feed() batch under `directory`
+        before it enters the tenant's queue, so a kill at ANY point
+        loses nothing the caller was told was accepted: recover()
+        replays the un-checkpointed suffix bit-exactly. Returns False
+        (a no-op) under the GS_WAL=0 kill switch."""
+        if not wal_mod.enabled():
+            return False
+        self._wal_dir = directory
+        self._wal = wal_mod.WriteAheadLog(directory)
+        return True
+
+    def seal_wal(self) -> None:
+        """Durably close the journal (the graceful-drain marker). The
+        caller drains the queues and flushes checkpoints first —
+        core/serve.StreamServer.drain() owns that ordering."""
+        if self._wal is not None:
+            self._wal.seal()
+
+    def recover(self) -> dict:
+        """Crash recovery over an armed journal: discover journaled
+        tenants (admitting any unknown), resume each from its newest
+        checkpoint generation, then replay each tenant's journal
+        suffix past its checkpointed `wal_offset` straight into the
+        queues (bypassing admission capacity — these edges were
+        already accepted once). The next pump() then produces windows
+        bit-identical to a never-killed run."""
+        if self._wal_dir is None:
+            raise ValueError("enable_wal() first: recover() replays "
+                             "the journal the crashed process wrote")
+        info = wal_mod.scan(self._wal_dir)
+        for tid in sorted(info["offsets"]):
+            if tid not in self.tenants:
+                self.admit(tid)
+        resumed = self.resume_all()
+        offsets = {tid: self.resume_offset(tid)
+                   for tid in self.tenants}
+        replayed: Dict[str, int] = {}
+        for tid, _start, src, dst, _ts in wal_mod.replay(
+                self._wal_dir, offsets):
+            t = self.tenants.get(tid)
+            if t is None or t.closed:
+                continue
+            t.src = np.concatenate([t.src, src])
+            t.dst = np.concatenate([t.dst, dst])
+            replayed[tid] = replayed.get(tid, 0) + len(src)
+        telemetry.event("wal_replayed", durable=True,
+                        component="cohort", dir=self._wal_dir,
+                        tenants=len(replayed),
+                        edges=sum(replayed.values()),
+                        sealed=info["sealed"])
+        metrics.counter_inc("gs_wal_replayed_edges_total",
+                            sum(replayed.values()))
+        return {"resumed": resumed, "replayed_edges": replayed,
+                "sealed": info["sealed"]}
+
+    def checkpoint_all(self) -> int:
+        """Force-flush a checkpoint for every live tenant NOW,
+        regardless of cadence — the graceful-drain boundary
+        (core/serve.StreamServer.drain: queues are already dry, so
+        each snapshot covers the tenant's whole delivered stream).
+        No-op without enable_auto_checkpoint. Returns tenants saved."""
+        if self._ckpt_dir is None:
+            return 0
+        saved = 0
+        for tid in sorted(self.tenants):
+            t = self.tenants[tid]
+            checkpoint.save(self._ckpt_path(tid),
+                            self.tenant_state_dict(tid))
+            if t.ckpt_policy is not None:
+                t.ckpt_policy.mark(t.windows_done)
+            saved += 1
+        return saved
 
     def try_resume(self, tenant_id) -> bool:
         """Restore one tenant from its newest intact checkpoint

@@ -57,6 +57,42 @@ def _iter_edge_chunks_sync(path: str, chunk_bytes: int):
             yield arrays
 
 
+def tail_edge_file(path: str, stop, chunk_bytes: int = 1 << 20,
+                   poll_s: float = 0.2):
+    """Follow a growing 'src dst [ts]' file (the serving front-end's
+    file-tail source, core/serve.StreamServer.attach_file_tail):
+    yield parsed COO chunks for every complete appended line, polling
+    every `poll_s` seconds. `stop` is a threading.Event ending the
+    tail; on stop the final (possibly newline-less) record is flushed
+    through the parser — the same EOF contract as
+    `_iter_edge_chunks_sync`, so a producer that doesn't terminate
+    its last line still loses nothing."""
+    remainder = b""
+    with open(path, "rb") as f:
+        while not stop.is_set():
+            buf = f.read(chunk_bytes)
+            if not buf:
+                stop.wait(poll_s)
+                continue
+            data = remainder + buf
+            cut = data.rfind(b"\n")
+            if cut < 0:
+                remainder = data
+                continue
+            remainder = data[cut + 1:]
+            arrays = native.parse_edge_bytes(
+                faults.fire("parse", data[:cut + 1]))
+            if len(arrays[0]):
+                yield arrays
+        # drain: whatever landed between the last poll and stop, plus
+        # a final line with no trailing newline
+        remainder += f.read()
+    if remainder:
+        arrays = native.parse_edge_bytes(faults.fire("parse", remainder))
+        if len(arrays[0]):
+            yield arrays
+
+
 def iter_edge_chunks(path: str, chunk_bytes: int = 1 << 24,
                      prefetch: int = 2):
     """Stream a 'src dst [ts]' file as bounded-memory COO chunks: read

@@ -43,8 +43,10 @@ from . import segment as seg_ops
 from . import triangles as tri_ops
 from . import unionfind
 from ..utils import checkpoint
+from ..utils import faults
 from ..utils import metrics
 from ..utils import telemetry
+from ..utils import wal as wal_mod
 
 
 def _build_scan(eb: int, vb: int, kb: int):
@@ -153,6 +155,11 @@ class SummaryEngineBase:
             # auto-checkpoint config survives reset() like the timers
             self._ckpt_path = None
             self._ckpt_policy = None
+        if not hasattr(self, "_wal"):
+            # write-ahead journal config survives reset() too
+            self._wal = None
+            self._wal_dir = None
+            self._wal_tenant = "engine"
         elif self._ckpt_policy is not None:
             # re-anchor the cadence with the rewound cursor: a stale
             # high-water mark would suppress every due() until the new
@@ -201,6 +208,10 @@ class SummaryEngineBase:
             "vertex_bucket": self.vb,
             "windows_done": int(self.windows_done),
             "closed_partial": bool(self._closed_partial),
+            # journal offset at this finalized-window boundary (edges
+            # folded into the carry): resume_and_replay() re-feeds the
+            # WAL strictly past it (DESIGN.md §18)
+            "wal_offset": int(self.windows_done) * self.eb,
             "carry": (deg, labels, cover),
         }
         if getattr(self, "_tuner", None) is not None:
@@ -219,6 +230,12 @@ class SummaryEngineBase:
                                      self.eb, self.vb))
         self.windows_done = int(state["windows_done"])  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
         self._closed_partial = bool(state["closed_partial"])
+        woff = state.get("wal_offset")
+        if woff is not None and int(woff) > self.windows_done * self.eb:
+            raise ValueError(
+                "checkpoint wal_offset %d exceeds its own window "
+                "coverage (%d windows x eb=%d)" % (
+                    int(woff), self.windows_done, self.eb))
         self._carry = tuple(self._to_carry(a) for a in state["carry"])
         # .get: checkpoints from before the autotune key (and engines
         # with the tuner off) restore without it
@@ -272,6 +289,61 @@ class SummaryEngineBase:
         telemetry.event("resume", durable=True, component="engine",
                         path=used, windows_done=self.windows_done)
         return True
+
+    def enable_wal(self, directory: str,
+                   tenant: str = "engine") -> bool:
+        """Journal every process() call's edges under `directory`
+        BEFORE they fold (utils/wal.py), making this live-fed engine
+        a replayable source: after a kill, `resume_and_replay()`
+        restores the newest checkpoint and re-feeds the journal
+        suffix, reproducing the lost windows bit-exactly. Returns
+        False (a no-op) under the GS_WAL=0 kill switch."""
+        if not wal_mod.enabled():
+            return False
+        self._wal_dir = directory
+        self._wal_tenant = str(tenant)
+        self._wal = wal_mod.WriteAheadLog(directory)
+        return True
+
+    def seal_wal(self) -> None:
+        """Durably close the journal (the clean-drain marker)."""
+        if self._wal is not None:
+            self._wal.seal()
+
+    def resume_and_replay(self, ckpt_path: str) -> list:
+        """Kill recovery for a journal-armed engine: try_resume the
+        newest checkpoint, then replay the journal suffix past the
+        checkpointed `wal_offset` through process(). Returns the
+        replayed windows' summaries — everything the crashed process
+        computed (or had accepted) but never delivered, bit-identical
+        to the fault-free run's same windows."""
+        self.try_resume(ckpt_path)
+        if self._wal_dir is None:
+            return []
+        off = self.resume_offset()
+        parts_s, parts_d = [], []
+        for tid, _start, src, dst, _ts in wal_mod.replay(
+                self._wal_dir, {self._wal_tenant: off}):
+            if tid != self._wal_tenant:
+                continue
+            parts_s.append(src)
+            parts_d.append(dst)
+        edges = sum(len(s) for s in parts_s)
+        telemetry.event("wal_replayed", durable=True,
+                        component="engine", dir=self._wal_dir,
+                        edges=edges)
+        metrics.counter_inc("gs_wal_replayed_edges_total", edges)
+        if not edges:
+            return []
+        # suspend journaling for the replay feed: these edges are
+        # already in the journal — re-appending would double them on
+        # the NEXT recovery
+        live, self._wal = self._wal, None
+        try:
+            return self.process(np.concatenate(parts_s),
+                                np.concatenate(parts_d))
+        finally:
+            self._wal = live
 
     def resume_offset(self) -> int:
         """Edges already folded into the carried state: a resumed
@@ -332,6 +404,13 @@ class SummaryEngineBase:
                 "a previous process() call closed a partial window "
                 "(length not a multiple of edge_bucket); reset() before "
                 "feeding more of the stream")
+        if self._wal is not None:
+            # journal-before-fold: the edges are durable before any
+            # dispatch touches the carry, so a kill mid-call replays
+            # them from resume_offset() (the wal_enqueue fault site
+            # pins the append→fold gap in tests)
+            self._wal.append(self._wal_tenant, src, dst)
+            faults.fire("wal_enqueue", self._wal_tenant)
         self._closed_partial = n % self.eb != 0
         num_w = -(-n // self.eb)
         out = []

@@ -804,7 +804,7 @@ class TriangleWindowKernel:
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.kb = seg_ops.bucket_size(
             k_bucket if k_bucket else _tuned_kb(self.eb))
-        self.kb_max = seg_ops.bucket_size(2 * int(np.sqrt(self.eb)))
+        self.kb_max = seg_ops.bucket_size(2 * int(np.sqrt(self.eb)))  # gslint: disable=host-sync (numpy scalar math on a python int bucket, no device value in sight)
         # instance attribute shadows the class default when a committed
         # chunk sweep exists for this bucket on this backend
         self.MAX_STREAM_WINDOWS = _tuned_chunk(self.eb)
@@ -858,8 +858,8 @@ class TriangleWindowKernel:
         if n > self.eb:
             raise ValueError(f"window of {n} edges exceeds edge bucket "
                              f"{self.eb}")
-        s = seg_ops.pad_to(np.asarray(src, np.int32), self.eb, fill=self.vb)
-        d = seg_ops.pad_to(np.asarray(dst, np.int32), self.eb, fill=self.vb)
+        s = seg_ops.pad_to(np.asarray(src, np.int32), self.eb, fill=self.vb)  # gslint: disable=host-sync (host-input normalization: count() takes numpy/lists, never device values)
+        d = seg_ops.pad_to(np.asarray(dst, np.int32), self.eb, fill=self.vb)  # gslint: disable=host-sync (host-input normalization: count() takes numpy/lists, never device values)
         valid = seg_ops.pad_to(np.ones(n, bool), self.eb, fill=False)
         s, d, valid = jnp.asarray(s), jnp.asarray(d), jnp.asarray(valid)
         for kb in self._escalation_ladder():  # widen K only when a hub

@@ -1019,11 +1019,11 @@ class ShardedWindowEngine:
         state = {
             "vb": self.vb,
             "mesh_shape": [self.n],  # gslint: disable=ckpt-symmetry (provenance only — load adopts any mesh width)
-            "degree_state": np.asarray(self._degree_state),
-            "labels": np.asarray(self._labels),
+            "degree_state": np.asarray(self._degree_state),  # gslint: disable=host-sync (sanctioned checkpoint boundary: state_dict's batched gather, same discipline as scan_analytics.state_dict)
+            "labels": np.asarray(self._labels),  # gslint: disable=host-sync (sanctioned checkpoint boundary: state_dict's batched gather)
         }
         if self._bip_labels is not None:
-            state["bip_labels"] = np.asarray(self._bip_labels)
+            state["bip_labels"] = np.asarray(self._bip_labels)  # gslint: disable=host-sync (sanctioned checkpoint boundary: state_dict's batched gather)
         return state
 
     def load_state_dict(self, state: dict) -> None:

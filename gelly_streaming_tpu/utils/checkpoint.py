@@ -130,6 +130,17 @@ def save(path: str, tree: Any) -> None:
     written = tmp + ".npz"
     try:
         np.savez_compressed(tmp, **arrays)
+        # fsync the tmp BEFORE the rename: rename-then-crash must
+        # never install a checkpoint whose bytes were still in the
+        # page cache — the WAL replays only past the offset this file
+        # claims, so a torn newest generation would otherwise cost a
+        # rotation fallback it didn't need (utils/wal.py leans on
+        # this; the same discipline as the journal's own fsyncs)
+        fd = os.open(written, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         if os.path.exists(path):
             # one-generation rotation: between this replace and the
             # next, `path` is momentarily absent — restore-side

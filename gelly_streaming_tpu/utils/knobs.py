@@ -356,6 +356,45 @@ register("GS_TENANT_TPD", "int", 0, lo=0,
               "one vmapped dispatch with GS_AUTOTUNE=0)",
          default_text="0 (auto)")
 
+# durable serving front-end (utils/wal.py + core/serve.py)
+register("GS_WAL", "bool", True,
+         help="`0` is the write-ahead-journal kill switch: every "
+              "`enable_wal()` call site (cohort, engines, driver) "
+              "degrades to a no-op and the ingest paths stay "
+              "bit-identical to a journal-less run; 1 (default) lets "
+              "callers that explicitly enable a journal get one")
+register("GS_WAL_FSYNC_S", "float", 0.0, lo=0.0,
+         help="fsync batching interval of the edge journal: 0 "
+              "(default) fsyncs every append (tightest power-loss "
+              "window), >0 batches fsyncs to at most one per interval "
+              "(appends in between are flushed but not synced)",
+         default_text="0 (every append)")
+register("GS_WAL_SEGMENT_BYTES", "int", 1 << 26, lo=4096,
+         help="journal segment-rotation size: a segment past this "
+              "many bytes closes (fsync'd) and appends continue in a "
+              "fresh `wal_<n>.seg`; records never split across "
+              "segments",
+         default_text="67108864 (64 MiB)")
+register("GS_SERVE_PORT", "int", 0, lo=0, hi=65535,
+         help="TCP port of the serving front-end "
+              "(`core/serve.StreamServer`, 127.0.0.1); 0 in code = "
+              "OS-assigned ephemeral port (tests print `.port`)",
+         default_text="0 (ephemeral)")
+register("GS_SERVE_DRAIN_S", "float", 30.0, lo=0.0,
+         help="graceful-drain deadline: on SIGTERM the server stops "
+              "accepting, waits up to this long for in-flight "
+              "requests, pumps every queue dry, checkpoints, seals "
+              "the journal and exits 0; 0 = no deadline (wait "
+              "forever for in-flight requests)",
+         default_text="30")
+register("GS_SERVE_IDLE_S", "float", 60.0, lo=0.1,
+         help="per-connection deadline of the serving front-end: a "
+              "connection idle (no request) this long is closed, and "
+              "a response send stalled this long is SHED (durable "
+              "`serve_client_shed` event) so a slow client can never "
+              "wedge the pump",
+         default_text="60")
+
 # program cost observatory (utils/costmodel.py)
 register("GS_COSTMODEL", "bool", False,
          help="arm the program cost observatory "

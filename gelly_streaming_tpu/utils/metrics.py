@@ -190,6 +190,26 @@ class _Registry:
 _REG: Optional[_Registry] = None
 _REG_LOCK = threading.Lock()
 
+# extra /healthz sections from serving-layer providers (core/serve
+# registers "serve"): name -> zero-arg callable returning a JSON-able
+# dict, merged into health_snapshot() under the name. Mutated only
+# under _REG_LOCK.
+_HEALTH_SECTIONS: Dict[str, object] = {}
+
+
+def register_health_section(name: str, provider) -> None:
+    """Attach a named section to the `/healthz` body: `provider()` is
+    called per snapshot (its failure is reported in-place, never
+    raised into the probe). Idempotent per name — the latest provider
+    wins, so a restarted server re-registers cleanly."""
+    with _REG_LOCK:
+        _HEALTH_SECTIONS[name] = provider
+
+
+def unregister_health_section(name: str) -> None:
+    with _REG_LOCK:
+        _HEALTH_SECTIONS.pop(name, None)
+
 
 def _reg() -> _Registry:
     global _REG
@@ -563,6 +583,13 @@ def health_snapshot(now: Optional[float] = None) -> dict:
     snap["demotions"] = resilience.demotion_events()[-5:]
     snap["trace"] = telemetry.trace_id()
     snap["ledger"] = telemetry.ledger_path()
+    with _REG_LOCK:
+        sections = dict(_HEALTH_SECTIONS)
+    for name, provider in sections.items():
+        try:
+            snap[name] = provider()
+        except Exception as e:  # gslint: disable=except-hygiene (a broken serving-layer provider must degrade to an error cell in the probe body, never crash the health endpoint itself)
+            snap[name] = {"error": "%s: %s" % (type(e).__name__, e)}
     return snap
 
 

@@ -105,6 +105,16 @@ def stage_backoff_s() -> float:
     return knobs.get_float("GS_STAGE_BACKOFF_S")
 
 
+def backoff_s(attempt: int) -> float:
+    """The deterministic (jitterless) backoff ladder: base·2^attempt
+    seconds with the GS_STAGE_BACKOFF_S base. The stage guard sleeps
+    it between retries, and the serving front-end (core/serve.py)
+    returns it as the `retry_after_s` hint on a typed backpressure
+    response — one discipline, so a polite client and the in-process
+    retry pace identically."""
+    return stage_backoff_s() * (2 ** max(0, attempt))
+
+
 def guard_active() -> bool:
     """True when either knob arms the guard; callers keep their legacy
     inline path (and exact legacy exception types) otherwise."""
@@ -156,7 +166,6 @@ def call_guarded(stage: str, chunk, fn: Callable, *,
         retries = stage_retries()
     if timeout is None:
         timeout = stage_timeout_s()
-    backoff = stage_backoff_s()
     attempts: List[dict] = []
     for attempt in range(retries + 1):
         t0 = time.perf_counter()
@@ -199,7 +208,7 @@ def call_guarded(stage: str, chunk, fn: Callable, *,
                         chunk=telemetry.chunk_key(chunk),
                         attempt=attempt + 1,
                         outcome=attempts[-1]["outcome"])
-        time.sleep(backoff * (2 ** attempt))
+        time.sleep(backoff_s(attempt))
 
 
 # ----------------------------------------------------------------------

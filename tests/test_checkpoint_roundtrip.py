@@ -476,3 +476,241 @@ def test_save_is_atomic_and_tmp_is_process_unique(tmp_path):
     # the failed save leaked no tmp and left the good file intact
     assert sorted(os.listdir(tmp_path)) == ["a.npz"]
     assert ck.restore(path)["x"].tolist() == [0, 1, 2]
+
+
+# ======================================================================
+# WAL kill→replay exactness (utils/wal.py; ISSUE 12): with a journal
+# armed, a kill at ANY point — including BETWEEN the journal append
+# and the queue enqueue — recovers to results bit-identical to the
+# fault-free run, on the cohort, single-engine, and driver paths.
+# ======================================================================
+def _wal_stream(num_w, eb, vb, seed):
+    rng = np.random.default_rng(seed)
+    n = num_w * eb
+    return (rng.integers(0, vb, n).astype(np.int32),
+            rng.integers(0, vb, n).astype(np.int32))
+
+
+def test_engine_wal_kill_and_replay_exact(tmp_path):
+    from gelly_streaming_tpu.utils import faults
+
+    eb, vb, num_w = 256, 512, 8
+    src, dst = _wal_stream(num_w, eb, vb, seed=21)
+    baseline = StreamSummaryEngine(edge_bucket=eb,
+                                   vertex_bucket=vb).process(src, dst)
+
+    ckpt = str(tmp_path / "eng.npz")
+    a = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    assert a.enable_wal(str(tmp_path / "wal"))
+    a.enable_auto_checkpoint(ckpt, every_n_windows=2)
+    out = []
+    killed = False
+    try:
+        with faults.inject(faults.FaultSpec(
+                site="dispatch", on_call=3, fatal=True)):
+            for w in range(0, num_w, 2):
+                out += a.process(src[w * eb:(w + 2) * eb],
+                                 dst[w * eb:(w + 2) * eb])
+    except faults.InjectedFault:
+        killed = True
+    assert killed
+
+    b = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    assert b.enable_wal(str(tmp_path / "wal"))
+    replayed = b.resume_and_replay(ckpt)
+    # positional at-least-once combine: checkpointed prefix + replay
+    final = out[:b.windows_done - len(replayed)] + replayed
+    # the caller's view: delivered windows + the recovered tail, then
+    # feed the rest of the stream normally
+    off = b.resume_offset()
+    final += b.process(src[off:], dst[off:])
+    assert final == baseline
+
+
+def test_engine_wal_kill_between_append_and_fold(tmp_path):
+    """The narrowest window: the journal append returned but the fold
+    never ran (kill at the wal_enqueue site). Replay must recover the
+    accepted-but-never-processed edges."""
+    from gelly_streaming_tpu.utils import faults
+
+    eb, vb, num_w = 256, 512, 4
+    src, dst = _wal_stream(num_w, eb, vb, seed=22)
+    baseline = StreamSummaryEngine(edge_bucket=eb,
+                                   vertex_bucket=vb).process(src, dst)
+
+    ckpt = str(tmp_path / "eng.npz")
+    a = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    assert a.enable_wal(str(tmp_path / "wal"))
+    a.enable_auto_checkpoint(ckpt, every_n_windows=2)
+    out = a.process(src[:2 * eb], dst[:2 * eb])
+    with pytest.raises(faults.InjectedFault):
+        with faults.inject(faults.FaultSpec(
+                site="wal_enqueue", on_call=1, fatal=True)):
+            a.process(src[2 * eb:], dst[2 * eb:])
+
+    b = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    assert b.enable_wal(str(tmp_path / "wal"))
+    replayed = b.resume_and_replay(ckpt)
+    assert len(replayed) == 2  # the journaled-but-unfolded windows
+    assert out + replayed == baseline
+
+
+def test_cohort_wal_kill_between_append_and_enqueue(tmp_path):
+    """Cohort flavor of the narrowest window: feed() journaled the
+    batch, the kill landed before the queue concatenate. recover()
+    must replay it; the caller was told nothing (no ack), so the
+    at-least-once re-send of the SAME batch must not double-fold
+    (replay already covers it — the re-send is what a real producer
+    does only for un-acked batches, so here the recovered run feeds
+    the NEXT batches only)."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.utils import faults
+
+    eb, vb, num_w = 256, 512, 4
+    src, dst = _wal_stream(num_w, eb, vb, seed=23)
+    oracle = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    oracle.admit("t")
+    oracle.feed("t", src, dst)
+    want = oracle.pump()["t"]
+
+    wal_dir = str(tmp_path / "wal")
+    a = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert a.enable_wal(wal_dir)
+    a.enable_auto_checkpoint(str(tmp_path / "ck"), every_n_windows=2)
+    a.admit("t")
+    a.feed("t", src[:2 * eb], dst[:2 * eb])
+    got = a.pump()["t"]
+    with pytest.raises(faults.InjectedFault):
+        with faults.inject(faults.FaultSpec(
+                site="wal_enqueue", on_call=1, fatal=True)):
+            a.feed("t", src[2 * eb:], dst[2 * eb:])
+
+    b = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert b.enable_wal(wal_dir)
+    b.enable_auto_checkpoint(str(tmp_path / "ck"), every_n_windows=2)
+    info = b.recover()
+    assert info["resumed"]["t"] is True
+    assert info["replayed_edges"]["t"] == 2 * eb
+    got += b.pump()["t"]
+    assert got == want
+
+
+def test_cohort_wal_kill_mid_dispatch_replay_exact(tmp_path):
+    """Kill mid-cohort-dispatch (after several checkpointed rounds):
+    recover() + continued feeding equals the fault-free run, window
+    for window, for every tenant."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.utils import faults
+
+    eb, vb, num_w = 256, 512, 8
+    streams = {"a": _wal_stream(num_w, eb, vb, 24),
+               "b": _wal_stream(num_w, eb, vb, 25)}
+    want = {}
+    oracle = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    for tid in streams:
+        oracle.admit(tid)
+    for w in range(num_w):
+        for tid, (s, d) in streams.items():
+            oracle.feed(tid, s[w * eb:(w + 1) * eb],
+                        d[w * eb:(w + 1) * eb])
+        for tid, res in oracle.pump().items():
+            want.setdefault(tid, []).extend(res)
+
+    wal_dir = str(tmp_path / "wal")
+    a = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert a.enable_wal(wal_dir)
+    a.enable_auto_checkpoint(str(tmp_path / "ck"), every_n_windows=2)
+    for tid in streams:
+        a.admit(tid)
+    got = {tid: {} for tid in streams}
+    killed_at = None
+    try:
+        with faults.inject(faults.FaultSpec(
+                site="cohort_dispatch", on_call=5, fatal=True)):
+            for w in range(num_w):
+                for tid, (s, d) in sorted(streams.items()):
+                    a.feed(tid, s[w * eb:(w + 1) * eb],
+                           d[w * eb:(w + 1) * eb])
+                for tid, res in a.pump().items():
+                    base = a.windows_done(tid) - len(res)
+                    for i, r in enumerate(res):
+                        got[tid][base + i] = r
+    except faults.InjectedFault:
+        killed_at = w
+    assert killed_at is not None
+
+    b = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert b.enable_wal(wal_dir)
+    b.enable_auto_checkpoint(str(tmp_path / "ck"), every_n_windows=2)
+    info = b.recover()
+    assert any(info["resumed"].values())
+    for tid, res in b.pump().items():  # the replayed suffix
+        base = b.windows_done(tid) - len(res)
+        for i, r in enumerate(res):
+            got[tid][base + i] = r
+    for w in range(killed_at + 1, num_w):
+        for tid, (s, d) in sorted(streams.items()):
+            b.feed(tid, s[w * eb:(w + 1) * eb],
+                   d[w * eb:(w + 1) * eb])
+        for tid, res in b.pump().items():
+            base = b.windows_done(tid) - len(res)
+            for i, r in enumerate(res):
+                got[tid][base + i] = r
+    for tid in streams:
+        final = [got[tid][k] for k in sorted(got[tid])]
+        assert final == want[tid], tid
+
+
+def test_driver_wal_kill_and_replay_exact(tmp_path):
+    """The driver's LIVE feed path (run_arrays, count-based windows)
+    with the journal armed: kill mid-stream, resume_and_replay
+    reproduces the un-checkpointed windows bit-exactly."""
+    from gelly_streaming_tpu.utils import faults
+
+    src, dst = _stream(n=4096, v=384, seed=26)
+    eb = 512
+    full = _key(StreamingAnalyticsDriver(
+        window_ms=0, edge_bucket=eb,
+        vertex_bucket=1024).run_arrays(src, dst))
+
+    ckpt = str(tmp_path / "drv.npz")
+    a = StreamingAnalyticsDriver(window_ms=0, edge_bucket=eb,
+                                 vertex_bucket=1024)
+    assert a.enable_wal(str(tmp_path / "wal"))
+    a.enable_auto_checkpoint(ckpt, every_n_windows=2)
+    out = []
+    killed = False
+    try:
+        with faults.inject(faults.FaultSpec(
+                site="dispatch", on_call=3, fatal=True)):
+            for i in range(0, len(src), 2 * eb):
+                out += _key(a.run_arrays(src[i:i + 2 * eb],
+                                         dst[i:i + 2 * eb]))
+    except faults.InjectedFault:
+        killed = True
+    assert killed
+
+    b = StreamingAnalyticsDriver(window_ms=0, edge_bucket=eb,
+                                 vertex_bucket=1024)
+    assert b.enable_wal(str(tmp_path / "wal"))
+    replayed = _key(b.resume_and_replay(ckpt))
+    final = out[:b.windows_done - len(replayed)] + replayed
+    off = b.edges_done
+    final += _key(b.run_arrays(src[off:], dst[off:]))
+    assert final == full
+
+
+def test_driver_wal_checkpoint_offset_contract(tmp_path):
+    """The checkpoint carries wal_offset == edges_done, and a
+    hand-edited divergence is refused loudly."""
+    src, dst = _stream(n=1024, v=128, seed=27)
+    a = StreamingAnalyticsDriver(window_ms=0, edge_bucket=512,
+                                 vertex_bucket=1024)
+    a.run_arrays(src, dst)
+    state = a.state_dict()
+    assert state["wal_offset"] == state["edges_done"] == len(src)
+    state["wal_offset"] = 7
+    b = StreamingAnalyticsDriver(window_ms=0, edge_bucket=512,
+                                 vertex_bucket=1024)
+    with pytest.raises(ValueError, match="wal_offset"):
+        b.load_state_dict(state)

@@ -27,6 +27,8 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_HEALTH_STALE_S "
        "GS_TENANT_MAX GS_TENANT_QUEUE_WINDOWS GS_TENANT_ADMISSION "
        "GS_TENANT_TPD "
+       "GS_WAL GS_WAL_FSYNC_S GS_WAL_SEGMENT_BYTES "
+       "GS_SERVE_PORT GS_SERVE_DRAIN_S GS_SERVE_IDLE_S "
        "GS_COSTMODEL GS_COSTMODEL_PEAK_GFLOPS "
        "GS_COSTMODEL_PEAK_GBPS").split()
 

@@ -787,3 +787,71 @@ def test_update_perf_md_appends_block_when_markers_absent(tmp_path):
         out = f.read()
     assert out.startswith("# PERF")
     assert update_perf_md.MARK_BEGIN in out
+
+
+# ----------------------------------------------------------------------
+# chaos soak-summary schema (logs/CHAOS_*.json; ISSUE 12 serve leg)
+# ----------------------------------------------------------------------
+def _chaos_doc(**over):
+    doc = {
+        "parity": True,
+        "fault_classes_fired": ["kill_resume"],
+        "serve_leg": {
+            "parity": True,
+            "kill": {"parity": True},
+            "torn_tail": {"parity": True},
+            "slow_client": {"parity": True, "shed": True},
+            "drain": {"parity": True, "rc": 0, "sealed": True,
+                      "digest_match": True},
+        },
+    }
+    doc.update(over)
+    return doc
+
+
+def test_chaos_schema_accepts_well_formed_serve_leg():
+    doc = _chaos_doc()
+    assert perf_schema.is_chaos(doc)
+    assert perf_schema.validate_chaos(doc) == []
+
+
+def test_chaos_schema_rejects_divergence_and_bad_drain():
+    assert any("parity" in e for e in
+               perf_schema.validate_chaos(_chaos_doc(parity=False)))
+    bad = _chaos_doc()
+    bad["serve_leg"]["parity"] = False
+    assert any("serve_leg" in e for e in
+               perf_schema.validate_chaos(bad))
+    bad = _chaos_doc()
+    bad["serve_leg"]["drain"]["rc"] = 143
+    assert any("exit 0" in e for e in
+               perf_schema.validate_chaos(bad))
+    bad = _chaos_doc()
+    del bad["serve_leg"]["drain"]["sealed"]
+    assert any("sealed" in e for e in
+               perf_schema.validate_chaos(bad))
+
+
+def test_chaos_schema_legs_are_additive():
+    # older soaks predate newer legs: absent legs are fine, present
+    # ones must carry their keys
+    doc = _chaos_doc()
+    del doc["serve_leg"]
+    assert perf_schema.validate_chaos(doc) == []
+    doc = _chaos_doc(tenancy_leg={"parity": True})
+    errs = perf_schema.validate_chaos(doc)
+    assert any("tenancy_leg" in e and "faults_fired" in e
+               for e in errs)
+
+
+@pytest.mark.parametrize("fname", ["CHAOS_resident.json",
+                                   "CHAOS_tenancy.json",
+                                   "CHAOS_serve.json"])
+def test_committed_chaos_logs_validate(fname):
+    path = os.path.join(REPO, "logs", fname)
+    if not os.path.exists(path):
+        pytest.skip("%s not committed" % fname)
+    with open(path) as f:
+        doc = json.load(f)
+    assert perf_schema.is_chaos(doc)
+    assert perf_schema.validate_chaos(doc) == []

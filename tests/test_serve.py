@@ -1,0 +1,278 @@
+"""Serving front-end suite (core/serve.py): loopback end-to-end
+digest parity with the direct cohort feed, typed wire rejections with
+deterministic retry hints, bounded connections, slow-client shedding,
+graceful drain (zero queued windows lost + sealed journal), the
+file-tail source, and the /healthz `serve` section."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.serve import ServeClient, StreamServer
+from gelly_streaming_tpu.core.tenancy import TenantCohort
+from gelly_streaming_tpu.utils import faults
+from gelly_streaming_tpu.utils import metrics
+from gelly_streaming_tpu.utils import wal
+
+EB, VB = 256, 512
+
+
+def _stream(num_w, seed=0):
+    rng = np.random.default_rng(seed)
+    n = num_w * EB
+    return (rng.integers(0, VB, n).astype(np.int32),
+            rng.integers(0, VB, n).astype(np.int32))
+
+
+def _oracle(src, dst):
+    c = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    c.admit("t")
+    out = []
+    for i in range(0, len(src), EB):
+        c.feed("t", src[i:i + EB], dst[i:i + EB])
+        out += c.pump().get("t", [])
+    return out + c.close("t")
+
+
+@pytest.fixture
+def server(tmp_path):
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    cohort.enable_wal(str(tmp_path / "wal"))
+    cohort.enable_auto_checkpoint(str(tmp_path / "ckpt"),
+                                  every_n_windows=2)
+    srv = StreamServer(cohort, port=0).start()
+    yield srv
+    srv.close()
+
+
+def test_loopback_digest_equals_direct_feed(server):
+    src, dst = _stream(4, seed=1)
+    want = _oracle(src, dst)
+    cli = ServeClient(server.port)
+    try:
+        assert cli.admit("t")["ok"]
+        got = []
+        for i in range(0, len(src), EB):
+            r = cli.feed("t", src[i:i + EB], dst[i:i + EB])
+            assert r == {"ok": True, "accepted": EB}
+            got += [row["summary"] for row in
+                    cli.pump()["results"].get("t", [])]
+        got += [row["summary"] for row in
+                cli.close_tenant("t")["results"]]
+    finally:
+        cli.close()
+    assert got == want
+
+
+def test_backpressure_wire_response_carries_retry_hint(server,
+                                                       monkeypatch):
+    monkeypatch.setenv("GS_TENANT_QUEUE_WINDOWS", "1")
+    src, dst = _stream(3, seed=2)
+    cli = ServeClient(server.port)
+    try:
+        cli.admit("t")
+        assert cli.feed("t", src[:EB], dst[:EB])["ok"]
+        r1 = cli.feed("t", src[EB:3 * EB], dst[EB:3 * EB])
+        assert r1["ok"] is False
+        assert r1["error"] == "TenantBackpressure"
+        assert r1["queued"] == EB and r1["capacity"] == EB
+        assert r1["retry_after_s"] > 0
+        # consecutive rejections double the hint (the deterministic
+        # GS_STAGE_BACKOFF_S ladder), an accepted feed resets it
+        r2 = cli.feed("t", src[EB:3 * EB], dst[EB:3 * EB])
+        assert r2["retry_after_s"] == 2 * r1["retry_after_s"]
+        cli.pump()  # drain the queue
+        assert cli.feed("t", src[EB:2 * EB], dst[EB:2 * EB])["ok"]
+        r3 = cli.feed("t", src[EB:3 * EB], dst[EB:3 * EB])
+        assert r3["retry_after_s"] == r1["retry_after_s"]
+    finally:
+        cli.close()
+
+
+def test_unknown_tenant_and_bad_request_are_typed(server):
+    cli = ServeClient(server.port)
+    try:
+        r = cli.feed("ghost", [1], [2])
+        assert r["ok"] is False and r["error"] == "TenantRejected"
+        r = cli.request(op="nonsense")
+        assert r["ok"] is False and r["error"] == "BadRequest"
+    finally:
+        cli.close()
+
+
+def test_connection_cap_answers_typed_busy(tmp_path):
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0, max_connections=1).start()
+    try:
+        hold = ServeClient(srv.port)
+        hold.request(op="status")  # registered as active
+        extra = ServeClient(srv.port)
+        r = extra.request(op="status")
+        assert r["ok"] is False and r["error"] == "ServerBusy"
+        assert r["retry_after_s"] > 0
+        extra.close()
+        hold.close()
+    finally:
+        srv.close()
+
+
+@pytest.mark.faults
+def test_slow_client_is_shed_not_wedged(tmp_path, monkeypatch):
+    monkeypatch.setenv("GS_SERVE_IDLE_S", "0.3")
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0).start()
+    src, dst = _stream(2, seed=3)
+    try:
+        slow = ServeClient(srv.port, timeout=30)
+        slow.admit("t")
+        slow.feed("t", src[:EB], dst[:EB])
+        with faults.inject(faults.FaultSpec(
+                site="serve_send", on_call=1, action="hang",
+                seconds=1.0)):
+            with pytest.raises((ConnectionError, OSError)):
+                slow.pump()
+        # the pump still serves a fresh connection afterwards
+        cli = ServeClient(srv.port, timeout=30)
+        assert cli.feed("t", src[EB:], dst[EB:])["ok"]
+        assert len(cli.pump()["results"]["t"]) >= 1
+        cli.close()
+        slow.close()
+    finally:
+        srv.close()
+
+
+def test_idle_connection_is_closed(tmp_path, monkeypatch):
+    monkeypatch.setenv("GS_SERVE_IDLE_S", "0.3")
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0).start()
+    try:
+        cli = ServeClient(srv.port, timeout=30)
+        cli.request(op="status")
+        time.sleep(0.8)  # idle past the deadline
+        with pytest.raises((ConnectionError, OSError)):
+            cli.request(op="status")
+        cli.close()
+    finally:
+        srv.close()
+
+
+def test_drain_finalizes_queued_windows_and_seals(tmp_path):
+    """Graceful drain loses nothing: windows still queued at drain
+    time come out finalized, the digest equals the keep-running run,
+    and the journal is sealed."""
+    src, dst = _stream(4, seed=4)
+    want = _oracle(src, dst)
+    wal_dir = str(tmp_path / "wal")
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    cohort.enable_wal(wal_dir)
+    cohort.enable_auto_checkpoint(str(tmp_path / "ckpt"),
+                                  every_n_windows=2)
+    srv = StreamServer(cohort, port=0).start()
+    cli = ServeClient(srv.port)
+    cli.admit("t")
+    for i in range(0, len(src), EB):
+        assert cli.feed("t", src[i:i + EB], dst[i:i + EB])["ok"]
+    cli.close()
+    summary = srv.drain(deadline_s=5)
+    assert summary["sealed"] is True
+    assert summary["drained_windows"] == 4
+    got = [row["summary"] for row in srv.results["t"]]
+    assert got == want
+    assert wal.scan(wal_dir)["sealed"] is True
+    # a checkpoint per tenant was force-flushed at the boundary
+    assert os.path.exists(str(tmp_path / "ckpt" / "tenant_t.npz"))
+    srv.close()
+
+
+def test_file_tail_source_end_to_end(tmp_path):
+    src, dst = _stream(2, seed=5)
+    want = _oracle(src, dst)
+    path = str(tmp_path / "feed.txt")
+    with open(path, "w") as f:
+        pass
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    cohort.enable_wal(str(tmp_path / "wal"))
+    srv = StreamServer(cohort, port=0).start()
+    try:
+        srv.attach_file_tail(path, "t", poll_s=0.02)
+        with open(path, "a") as f:
+            for s, d in zip(src.tolist(), dst.tolist()):
+                f.write("%d %d\n" % (s, d))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            srv.pump_once()
+            if sum(len(v) for v in srv.results.values()) >= 2:
+                break
+            time.sleep(0.05)
+        got = [row["summary"] for row in srv.results["t"]]
+        assert got == want[:len(got)] and len(got) == 2
+        # the tailed edges went through the journal too
+        assert wal.scan(str(tmp_path / "wal"))["offsets"]["t"] \
+            == 2 * EB
+    finally:
+        srv.drain(deadline_s=5)
+        srv.close()
+
+
+def test_healthz_serve_section(server, monkeypatch):
+    monkeypatch.setenv("GS_METRICS", "1")
+    metrics.reset()
+    try:
+        cli = ServeClient(server.port)
+        cli.admit("t")
+        src, dst = _stream(1, seed=6)
+        cli.feed("t", src, dst)
+        cli.pump()
+        snap = metrics.health_snapshot()
+        sec = snap["serve"]
+        assert sec["port"] == server.port
+        assert sec["windows"] >= 1 and sec["requests"] >= 3
+        assert sec["wal"]["edges"] == EB
+        assert sec["draining"] is False
+        status = cli.status()
+        assert status["serve"]["port"] == server.port
+        cli.close()
+    finally:
+        metrics.reset()
+
+
+def test_results_sink_jsonl(tmp_path):
+    src, dst = _stream(2, seed=7)
+    results = str(tmp_path / "out.jsonl")
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0,
+                       results_path=results).start()
+    try:
+        cli = ServeClient(srv.port)
+        cli.admit("t")
+        cli.feed("t", src, dst)
+        cli.pump()
+        cli.close()
+    finally:
+        srv.drain(deadline_s=5)
+        srv.close()
+    rows = [json.loads(line) for line in open(results)]
+    assert [r["window"] for r in rows] == [0, 1]
+    assert all(r["tenant"] == "t" for r in rows)
+    assert all("max_degree" in r["summary"] for r in rows)
+
+
+def test_missing_fields_come_back_as_bad_request(server):
+    """Review fix: a request missing required fields must produce the
+    typed BadRequest the protocol promises, not an uncaught KeyError
+    that kills the connection thread with no reply."""
+    cli = ServeClient(server.port)
+    try:
+        r = cli.request(op="feed")  # no tenant/src/dst
+        assert r["ok"] is False and r["error"] == "BadRequest"
+        assert "KeyError" in r["message"]
+        r = cli.request(op="admit")  # no tenant
+        assert r["ok"] is False and r["error"] == "BadRequest"
+        # the connection survived: a well-formed request still works
+        assert cli.request(op="status")["ok"] is True
+    finally:
+        cli.close()

@@ -1,0 +1,408 @@
+"""Write-ahead edge journal unit suite (utils/wal.py): record
+framing + CRC, segment rotation, torn-tail fallback vs mid-journal
+corruption, reopen/quarantine, offset-trimmed replay, seal, bounded
+retention — plus the edge-source EOF regression the journal's
+durability story leans on (a final line with no trailing newline is
+never stranded)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.io import sources
+from gelly_streaming_tpu.utils import wal
+
+pytestmark = pytest.mark.faults
+
+
+def _edges(n, seed=0, dtype=np.int32):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 100, n).astype(dtype),
+            rng.integers(0, 100, n).astype(dtype))
+
+
+def _mk(tmp_path, name="wal"):
+    return wal.WriteAheadLog(str(tmp_path / name))
+
+
+# ----------------------------------------------------------------------
+# framing / offsets / replay
+# ----------------------------------------------------------------------
+def test_append_replay_roundtrip(tmp_path):
+    w = _mk(tmp_path)
+    s1, d1 = _edges(5, 1)
+    s2, d2 = _edges(3, 2)
+    assert w.append("t1", s1, d1) == (0, 5)
+    assert w.append("t1", s2, d2) == (5, 8)
+    assert w.offsets() == {"t1": 8}
+    w.close()
+    got = list(wal.replay(w.dir))
+    assert [(t, st) for t, st, *_ in got] == [("t1", 0), ("t1", 5)]
+    np.testing.assert_array_equal(got[0][2], s1)
+    np.testing.assert_array_equal(got[1][3], d2)
+    assert got[0][4] is None
+
+
+def test_replay_trims_straddling_record(tmp_path):
+    w = _mk(tmp_path)
+    s, d = _edges(10, 3)
+    w.append("t1", s, d)
+    w.close()
+    (tid, start, rs, rd, _ts), = wal.replay(w.dir, {"t1": 4})
+    assert (tid, start) == ("t1", 4)
+    np.testing.assert_array_equal(rs, s[4:])
+    np.testing.assert_array_equal(rd, d[4:])
+    # fully covered: nothing replays
+    assert list(wal.replay(w.dir, {"t1": 10})) == []
+
+
+def test_int64_and_timestamps_roundtrip(tmp_path):
+    w = _mk(tmp_path)
+    s, d = _edges(4, 4, dtype=np.int64)
+    ts = np.array([10, 20, 30, 40], np.int64)
+    w.append("drv", s, d, ts=ts)
+    w.close()
+    (_t, _st, rs, _rd, rts), = wal.replay(w.dir)
+    assert rs.dtype == np.int64
+    np.testing.assert_array_equal(rts, ts)
+
+
+def test_per_tenant_interleaving(tmp_path):
+    w = _mk(tmp_path)
+    for i in range(3):
+        w.append("a", *_edges(2, i))
+        w.append("b", *_edges(4, 10 + i))
+    assert w.offsets() == {"a": 6, "b": 12}
+    w.close()
+    info = wal.scan(w.dir)
+    assert info["offsets"] == {"a": 6, "b": 12}
+    assert info["seqs"] == {"a": 3, "b": 3}
+    assert info["records"] == 6 and not info["sealed"]
+    # replay with one tenant fully covered yields only the other
+    got = list(wal.replay(w.dir, {"a": 6}))
+    assert {t for t, *_ in got} == {"b"}
+
+
+# ----------------------------------------------------------------------
+# segment rotation & retention
+# ----------------------------------------------------------------------
+def test_segment_rotation_and_reopen(tmp_path, monkeypatch):
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    w = _mk(tmp_path)
+    for i in range(6):
+        w.append("t", np.zeros(900, np.int32), np.zeros(900, np.int32))
+    w.close()
+    segs = [f for f in os.listdir(w.dir) if f.endswith(".seg")]
+    assert len(segs) > 1  # rotation happened
+    assert wal.scan(w.dir)["offsets"] == {"t": 5400}
+    # reopen recovers offsets and continues in a FRESH segment
+    w2 = wal.WriteAheadLog(w.dir)
+    assert w2.offsets() == {"t": 5400}
+    assert w2.append("t", *_edges(1)) == (5400, 5401)
+    w2.close()
+    assert wal.scan(w.dir)["records"] == 7
+
+
+def test_truncate_covered_never_deletes_uncheckpointed(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    w = _mk(tmp_path)
+    for i in range(6):
+        w.append("t", np.zeros(900, np.int32), np.zeros(900, np.int32))
+    before = len([f for f in os.listdir(w.dir)
+                  if f.endswith(".seg")])
+    removed = w.truncate_covered({"t": 1800})  # first 2 records
+    after = len([f for f in os.listdir(w.dir) if f.endswith(".seg")])
+    assert removed >= 1 and after == before - removed
+    # the un-covered suffix still replays intact
+    got = list(wal.replay(w.dir, {"t": 1800}))
+    assert sum(len(s) for _t, _st, s, _d, _ts in got) == 3600
+    w.close()
+
+
+# ----------------------------------------------------------------------
+# damage: torn tail tolerated, anything else typed-raises
+# ----------------------------------------------------------------------
+def test_torn_tail_falls_back_one_record(tmp_path):
+    w = _mk(tmp_path)
+    w.append("t", *_edges(5, 1))
+    w.append("t", *_edges(5, 2))
+    w.close()
+    seg = sorted(os.path.join(w.dir, f) for f in os.listdir(w.dir))[0]
+    with open(seg, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 3)
+    info = wal.scan(w.dir)
+    assert info["records"] == 1 and info["offsets"] == {"t": 5}
+    assert info["torn"] is not None
+    assert len(list(wal.replay(w.dir))) == 1
+
+
+def test_crc_flip_at_tail_is_torn(tmp_path):
+    w = _mk(tmp_path)
+    w.append("t", *_edges(5, 1))
+    w.append("t", *_edges(5, 2))
+    w.close()
+    seg = sorted(os.path.join(w.dir, f) for f in os.listdir(w.dir))[0]
+    size = os.path.getsize(seg)
+    with open(seg, "r+b") as f:
+        f.seek(size - 2)
+        b = f.read(1)
+        f.seek(size - 2)
+        f.write(bytes([b[0] ^ 0xFF]))
+    info = wal.scan(w.dir)
+    assert info["records"] == 1
+    assert "CRC" in info["torn"]["problem"]
+
+
+def test_mid_journal_damage_raises_typed(tmp_path, monkeypatch):
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    w = _mk(tmp_path)
+    for i in range(4):
+        w.append("t", np.zeros(900, np.int32), np.zeros(900, np.int32))
+    w.close()
+    first = sorted(os.path.join(w.dir, f)
+                   for f in os.listdir(w.dir))[0]
+    with open(first, "r+b") as f:
+        f.seek(30)
+        f.write(b"\xde\xad")
+    with pytest.raises(wal.WalCorrupt):
+        list(wal.replay(w.dir))
+    with pytest.raises(wal.WalCorrupt):
+        wal.scan(w.dir)
+
+
+def test_reopen_quarantines_torn_tail(tmp_path):
+    """A reopened journal TRUNCATES the torn bytes before appending a
+    fresh segment — otherwise the damaged tail would later read as
+    mid-journal corruption once it is no longer the last segment."""
+    w = _mk(tmp_path)
+    w.append("t", *_edges(5, 1))
+    w.append("t", *_edges(5, 2))
+    w.close()
+    seg = sorted(os.path.join(w.dir, f) for f in os.listdir(w.dir))[0]
+    with open(seg, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 3)
+    w2 = wal.WriteAheadLog(w.dir)   # quarantine happens here
+    assert w2.offsets() == {"t": 5}
+    assert w2.append("t", *_edges(2, 3)) == (5, 7)
+    w2.close()
+    # the whole journal (old segment no longer last) scans clean
+    info = wal.scan(w.dir)
+    assert info["torn"] is None and info["offsets"] == {"t": 7}
+
+
+def test_seq_gap_raises_typed(tmp_path):
+    w = _mk(tmp_path)
+    w.append("t", *_edges(3, 1))
+    w.append("t", *_edges(3, 2))
+    w.append("t", *_edges(3, 3))
+    w.close()
+    # surgically remove the middle record from the segment
+    seg = sorted(os.path.join(w.dir, f) for f in os.listdir(w.dir))[0]
+    data = open(seg, "rb").read()
+    head = 8  # magic
+    import struct
+    recs = []
+    off = head
+    while off < len(data):
+        _crc, ln = struct.unpack_from("<II", data, off)
+        recs.append(data[off:off + 8 + ln])
+        off += 8 + ln
+    with open(seg, "wb") as f:
+        f.write(data[:head] + recs[0] + recs[2])
+    with pytest.raises(wal.WalCorrupt, match="sequence gap"):
+        list(wal.replay(w.dir))
+
+
+# ----------------------------------------------------------------------
+# seal & disarm
+# ----------------------------------------------------------------------
+def test_seal_marks_journal_and_refuses_appends(tmp_path):
+    w = _mk(tmp_path)
+    w.append("t", *_edges(3, 1))
+    w.seal()
+    assert wal.scan(w.dir)["sealed"] is True
+    with pytest.raises(ValueError, match="sealed"):
+        w.append("t", *_edges(1))
+    # a reopened journal may accept a NEW stream (service restart)
+    w2 = wal.WriteAheadLog(w.dir)
+    w2.append("t", *_edges(2, 2))
+    w2.close()
+    assert wal.scan(w.dir)["sealed"] is False
+
+
+def test_fsync_batching_interval(tmp_path, monkeypatch):
+    calls = []
+    real = os.fsync
+    monkeypatch.setattr(os, "fsync", lambda fd: (calls.append(fd),
+                                                 real(fd))[1])
+    monkeypatch.setenv("GS_WAL_FSYNC_S", "3600")
+    w = _mk(tmp_path)
+    for i in range(5):
+        w.append("t", *_edges(2, i))
+    batched = len(calls)
+    w.sync()
+    assert len(calls) == batched + 1  # the forced flush
+    monkeypatch.setenv("GS_WAL_FSYNC_S", "0")
+    w.append("t", *_edges(2, 9))
+    assert len(calls) == batched + 2  # per-append again
+    w.close()
+
+
+def test_gs_wal_zero_disarms_every_enable_site(tmp_path, monkeypatch):
+    monkeypatch.setenv("GS_WAL", "0")
+    from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    cohort = TenantCohort(edge_bucket=64, vertex_bucket=128)
+    assert cohort.enable_wal(str(tmp_path / "a")) is False
+    eng = StreamSummaryEngine(edge_bucket=64, vertex_bucket=128)
+    assert eng.enable_wal(str(tmp_path / "b")) is False
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=64,
+                                   vertex_bucket=128)
+    assert drv.enable_wal(str(tmp_path / "c")) is False
+    # nothing was created: the disarmed path leaves no journal at all
+    assert not os.path.exists(str(tmp_path / "a"))
+    # and the disarmed digests are the journal-less ones by
+    # construction (no WAL object exists to consult)
+    cohort.admit("t")
+    s = np.arange(64, dtype=np.int32) % 100
+    cohort.feed("t", s, s[::-1].copy())
+    plain = TenantCohort(edge_bucket=64, vertex_bucket=128)
+    plain.admit("t")
+    plain.feed("t", s, s[::-1].copy())
+    assert cohort.pump() == plain.pump()
+
+
+# ----------------------------------------------------------------------
+# edge-source EOF regression (the satellite fix's pin): a file whose
+# last line lacks a trailing newline must never strand its final
+# record — sync path, prefetch path, and the serving file-tail
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("chunk_bytes", [4, 1 << 20])
+def test_final_line_without_newline_is_flushed(tmp_path, chunk_bytes):
+    p = str(tmp_path / "edges.txt")
+    with open(p, "w") as f:
+        f.write("1 2\n3 4\n5 6")  # no trailing newline
+    got = list(sources._iter_edge_chunks_sync(p, chunk_bytes))
+    src = np.concatenate([c[0] for c in got])
+    dst = np.concatenate([c[1] for c in got])
+    np.testing.assert_array_equal(src, [1, 3, 5])
+    np.testing.assert_array_equal(dst, [2, 4, 6])
+
+
+def test_final_line_without_newline_prefetch_path(tmp_path):
+    p = str(tmp_path / "edges.txt")
+    with open(p, "w") as f:
+        f.write("7 8\n9 10")
+    got = list(sources.iter_edge_chunks(p, chunk_bytes=4, prefetch=2))
+    src = np.concatenate([c[0] for c in got])
+    np.testing.assert_array_equal(src, [7, 9])
+
+
+def test_tail_edge_file_flushes_final_partial_line(tmp_path):
+    import threading
+
+    p = str(tmp_path / "tail.txt")
+    with open(p, "w") as f:
+        f.write("1 2\n")
+    stop = threading.Event()
+    got = []
+
+    def consume():
+        for s, d, _ts in sources.tail_edge_file(p, stop,
+                                                poll_s=0.01):
+            got.append((s, d))
+
+    t = threading.Thread(target=consume)
+    t.start()
+    import time
+
+    time.sleep(0.1)
+    with open(p, "a") as f:
+        f.write("3 4\n5 6")  # appended; final line unterminated
+    time.sleep(0.2)
+    stop.set()
+    t.join(5)
+    assert not t.is_alive()
+    src = np.concatenate([s for s, _d in got])
+    np.testing.assert_array_equal(np.sort(src), [1, 3, 5])
+
+
+def test_reopen_after_truncate_never_collides_segments(tmp_path,
+                                                       monkeypatch):
+    """Review fix: the next segment index derives from the highest
+    EXISTING name, not the count — after truncate_covered() deletes
+    prefix segments, a count-derived index re-opened a live segment
+    and wrote a second magic header mid-file."""
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    w = _mk(tmp_path)
+    for i in range(6):
+        w.append("t", np.zeros(900, np.int32), np.zeros(900, np.int32))
+    w.close()
+    assert w.truncate_covered({"t": 1800}) >= 1
+    w2 = wal.WriteAheadLog(w.dir)  # reopen AFTER the prefix deletion
+    w2.append("t", *_edges(3, 9))
+    w2.close()
+    info = wal.scan(w.dir)  # a collision would raise / drop records
+    assert info["torn"] is None
+    assert info["offsets"] == {"t": 5403}
+
+
+def test_append_canonicalizes_mismatched_dtypes(tmp_path):
+    """Review fix: one itemsize frames BOTH id arrays — mismatched
+    or exotic dtypes are canonicalized to int64 instead of replaying
+    CRC-valid garbage."""
+    w = _mk(tmp_path)
+    w.append("t", np.array([1, 2], np.int32),
+             np.array([3, 4], np.int64))
+    w.append("t", np.array([5.0, 6.0]), np.array([7, 8], np.int16))
+    w.close()
+    recs = list(wal.replay(w.dir))
+    np.testing.assert_array_equal(recs[0][2], [1, 2])
+    np.testing.assert_array_equal(recs[0][3], [3, 4])
+    np.testing.assert_array_equal(recs[1][2], [5, 6])
+    np.testing.assert_array_equal(recs[1][3], [7, 8])
+    assert recs[0][2].dtype == np.int64
+
+
+def test_driver_rejected_batch_leaves_no_journal_record(tmp_path):
+    """Review fix: run_arrays journals AFTER validation — a rejected
+    batch (non-ascending timestamps) must leave the journal
+    untouched, or replay re-raises the rejection and every later
+    offset skews against edges_done."""
+    from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+
+    drv = StreamingAnalyticsDriver(window_ms=100, edge_bucket=64,
+                                   vertex_bucket=128)
+    assert drv.enable_wal(str(tmp_path / "wal"))
+    with pytest.raises(ValueError, match="ascending"):
+        drv.run_arrays(np.array([1, 2]), np.array([3, 4]),
+                       ts=np.array([500, 100]))
+    assert wal.scan(str(tmp_path / "wal"))["records"] == 0
+    # an accepted event-time batch DOES journal, with its timestamps
+    drv.run_arrays(np.array([1, 2]), np.array([3, 4]),
+                   ts=np.array([100, 500]))
+    (_t, _s, _src, _dst, ts), = wal.replay(str(tmp_path / "wal"))
+    np.testing.assert_array_equal(ts, [100, 500])
+
+
+def test_stream_file_refused_on_journal_armed_driver(tmp_path):
+    """Review fix: wal_offset is DEFINED as edges_done, and
+    stream_file edges are never journaled — mixing the sources would
+    make recovery skip journaled live edges, so it is refused."""
+    from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+
+    p = str(tmp_path / "edges.txt")
+    with open(p, "w") as f:
+        f.write("1 2\n")
+    drv = StreamingAnalyticsDriver(window_ms=0, edge_bucket=64,
+                                   vertex_bucket=128)
+    assert drv.enable_wal(str(tmp_path / "wal"))
+    with pytest.raises(ValueError, match="journal-armed"):
+        list(drv.stream_file(p))

@@ -31,6 +31,22 @@ Schedule (all deterministic, utils/faults — no randomness anywhere):
           with the fault-free SCAN-tier oracle (cross-tier: the
           donated carry never leaks a half-applied super-batch)
 
+  leg S — the SERVE drill (core/serve.py + utils/wal.py): two
+          tenants fed through a real loopback socket into a
+          journal-armed StreamServer
+            · fatal kill mid-window  → fresh cohort recovers
+              (checkpoint resume + WAL suffix replay) and the
+              per-tenant digests equal the fault-free direct oracle
+            · torn journal tail      → recovery falls back exactly
+              one record (durable wal_torn_tail), resend restores
+              parity
+            · slow client            → a stalled response send is
+              shed (durable serve_client_shed); the pump keeps
+              serving
+            · SIGTERM drain          → a standalone subprocess exits
+              0 with every accepted window in its results file and a
+              SEALED journal
+
   leg M — the MESH drill (virtual n-device CPU mesh, armed via
           --mesh-devices; the process pins a CPU backend with that
           many virtual devices before jax initializes): a sharded
@@ -515,6 +531,353 @@ def _leg_tenancy_body(workdir: str, np, TenantCohort) -> dict:
     }
 
 
+def _summaries_digest(summaries) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    for s in summaries:
+        h.update(json.dumps(s, sort_keys=True).encode())
+    return h.hexdigest()[:16]
+
+
+def _ledger_has(name: str) -> bool:
+    path = telemetry.ledger_path()
+    if path is None or not os.path.exists(path):
+        return False
+    with open(path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("t") == "event" and rec.get("name") == name:
+                return True
+    return False
+
+
+def leg_serve(workdir: str) -> dict:
+    """The durable-serving drill (core/serve.py + utils/wal.py), four
+    sub-legs on one schedule:
+
+      · KILL mid-window: two tenants fed through a real loopback
+        socket into a journal-armed server; a fatal `cohort_dispatch`
+        fault kills the pump mid-round. A FRESH cohort recovers
+        (checkpoint resume + WAL suffix replay), serving continues,
+        and the final per-tenant summary streams are bit-identical to
+        the fault-free direct-feed oracle — exactly-once window
+        results under a kill at an arbitrary point.
+      · TORN TAIL: the journal's final record is physically truncated
+        (the shape an in-flight crash tears). Recovery falls back
+        exactly one record with a durable `wal_torn_tail` event; the
+        producer re-sends its un-acknowledged tail and parity holds.
+      · SLOW CLIENT: a response send stalled past GS_SERVE_IDLE_S is
+        SHED (durable `serve_client_shed`) and the pump keeps serving
+        other connections — a stalled reader can never wedge ingest.
+      · GRACEFUL DRAIN: a standalone server subprocess takes SIGTERM
+        during active ingest and exits 0 with every accepted window
+        finalized in its results file (drain digest ≡ keep-running
+        digest) and a SEALED journal.
+    """
+    import numpy as np
+
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.utils import wal as wal_mod
+
+    eb, vb, num_w = 512, 1024, 8
+    streams = {}
+    for i in range(2):
+        s, d = make_stream(num_w * eb, vb, seed=60 + i)
+        streams["s%d" % i] = (s.astype(np.int32), d.astype(np.int32))
+
+    # fault-free oracle: the direct cohort feed
+    oracle = {}
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    for tid in streams:
+        co.admit(tid)
+    for w in range(num_w):
+        for tid, (s, d) in streams.items():
+            co.feed(tid, s[w * eb:(w + 1) * eb],
+                    d[w * eb:(w + 1) * eb])
+        for tid, res in co.pump().items():
+            oracle.setdefault(tid, []).extend(res)
+    for tid in streams:
+        oracle[tid].extend(co.close(tid))
+
+    env_prev = os.environ.get("GS_STAGE_TIMEOUT_S")
+    os.environ["GS_STAGE_TIMEOUT_S"] = "30"
+    try:
+        out = {
+            "kill": _serve_kill_subleg(workdir, np, StreamServer,
+                                       ServeClient, TenantCohort,
+                                       wal_mod, streams, oracle, eb,
+                                       vb, num_w),
+            "torn_tail": _serve_torn_subleg(workdir, np, TenantCohort,
+                                            wal_mod, eb, vb),
+            "slow_client": _serve_slow_subleg(workdir, np,
+                                              StreamServer,
+                                              ServeClient,
+                                              TenantCohort, eb, vb),
+            "drain": _serve_drain_subleg(workdir, np, streams,
+                                         oracle, eb, vb, num_w),
+        }
+    finally:
+        if env_prev is None:
+            os.environ.pop("GS_STAGE_TIMEOUT_S", None)
+        else:
+            os.environ["GS_STAGE_TIMEOUT_S"] = env_prev
+    out["parity"] = all(v.get("parity") for v in out.values())
+    if not out["parity"]:
+        raise SystemExit("chaos serve leg DIVERGED: %r" % out)
+    return out
+
+
+def _serve_kill_subleg(workdir, np, StreamServer, ServeClient,
+                       TenantCohort, wal_mod, streams, oracle, eb,
+                       vb, num_w) -> dict:
+    wal_dir = os.path.join(workdir, "serve_wal")
+    ck_dir = os.path.join(workdir, "serve_ckpt")
+
+    cohort = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert cohort.enable_wal(wal_dir)
+    cohort.enable_auto_checkpoint(ck_dir, every_n_windows=2)
+    server = StreamServer(cohort, port=0).start()
+    cli = ServeClient(server.port, timeout=60)
+    got = {tid: {} for tid in streams}
+    fired, killed, killed_at = [], False, None
+
+    def take(results):
+        for tid, rows in results.items():
+            for row in rows:
+                got[tid][row["window"]] = row["summary"]
+
+    try:
+        with faults.inject(faults.FaultSpec(
+                site="cohort_dispatch", on_call=4,
+                fatal=True)) as plan:
+            for tid in sorted(streams):
+                assert cli.admit(tid)["ok"]
+            for w in range(num_w):
+                for tid, (s, d) in sorted(streams.items()):
+                    r = cli.feed(tid, s[w * eb:(w + 1) * eb],
+                                 d[w * eb:(w + 1) * eb])
+                    assert r["ok"], r
+                take(cli.pump()["results"])
+    except (ConnectionError, OSError):
+        killed = True
+        killed_at = w
+        fired = list(plan.fired)
+    cli.close()
+    server.close()
+    if not killed or not server.fatal:
+        raise SystemExit("chaos serve leg: the kill never fired "
+                         "(fired=%r)" % (plan.fired,))
+
+    # restart: fresh cohort, checkpoint resume + WAL suffix replay
+    co2 = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert co2.enable_wal(wal_dir)
+    co2.enable_auto_checkpoint(ck_dir, every_n_windows=2)
+    rec = co2.recover()
+    if not any(rec["resumed"].values()):
+        raise SystemExit("chaos serve leg: no tenant resumed a "
+                         "checkpoint after the kill")
+    if not _ledger_has("wal_replayed"):
+        raise SystemExit("chaos serve leg: no durable wal_replayed "
+                         "event in the ledger")
+    s2 = StreamServer(co2, port=0).start()
+    cli2 = ServeClient(s2.port, timeout=60)
+    take(cli2.pump()["results"])  # the replayed suffix's windows
+    for w in range(killed_at + 1, num_w):
+        for tid, (s, d) in sorted(streams.items()):
+            assert cli2.feed(tid, s[w * eb:(w + 1) * eb],
+                             d[w * eb:(w + 1) * eb])["ok"]
+        take(cli2.pump()["results"])
+    for tid in sorted(streams):
+        take({tid: cli2.close_tenant(tid)["results"]})
+    cli2.close()
+    s2.close()
+    final = {tid: [got[tid][k] for k in sorted(got[tid])]
+             for tid in streams}
+    for tid in streams:
+        if final[tid] != oracle[tid]:
+            raise SystemExit(
+                "chaos serve leg DIVERGED from the fault-free oracle "
+                "for tenant %s (%d vs %d windows)"
+                % (tid, len(final[tid]), len(oracle[tid])))
+    return {
+        "parity": True,
+        "killed_at_window": killed_at,
+        "replayed_edges": rec["replayed_edges"],
+        "faults_fired": [list(f) for f in fired],
+        "digests": {tid: _summaries_digest(final[tid])
+                    for tid in sorted(streams)},
+    }
+
+
+def _serve_torn_subleg(workdir, np, TenantCohort, wal_mod, eb,
+                       vb) -> dict:
+    wal_dir = os.path.join(workdir, "torn_wal")
+    s, d = make_stream(3 * eb, vb, seed=70)
+    s, d = s.astype(np.int32), d.astype(np.int32)
+    oracle = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    oracle.admit("t")
+    oracle.feed("t", s, d)
+    want = oracle.pump()["t"]
+
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert co.enable_wal(wal_dir)
+    co.admit("t")
+    for w in range(3):  # three journal records, never pumped
+        co.feed("t", s[w * eb:(w + 1) * eb], d[w * eb:(w + 1) * eb])
+    co._wal.close()  # the crash: queues die with the process
+
+    # physical tail damage: the last record loses its final bytes
+    seg = sorted(os.path.join(wal_dir, f)
+                 for f in os.listdir(wal_dir))[-1]
+    with open(seg, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 3)
+
+    co2 = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    assert co2.enable_wal(wal_dir)
+    rec = co2.recover()
+    replayed = rec["replayed_edges"].get("t", 0)
+    if replayed != 2 * eb:
+        raise SystemExit(
+            "chaos serve torn-tail: expected the replay to fall back "
+            "exactly one record (%d edges), got %d"
+            % (2 * eb, replayed))
+    if not _ledger_has("wal_torn_tail"):
+        raise SystemExit("chaos serve torn-tail: no durable "
+                         "wal_torn_tail event in the ledger")
+    # the producer's un-acked tail is re-sent (its fsync never
+    # completed, so it was never acknowledged durable) — parity holds
+    co2.feed("t", s[2 * eb:], d[2 * eb:])
+    have = co2.pump()["t"]
+    if have != want:
+        raise SystemExit("chaos serve torn-tail DIVERGED after "
+                         "fallback+resend")
+    return {"parity": True, "replayed_edges": replayed,
+            "dropped_records": 1}
+
+
+def _serve_slow_subleg(workdir, np, StreamServer, ServeClient,
+                       TenantCohort, eb, vb) -> dict:
+    prev = os.environ.get("GS_SERVE_IDLE_S")
+    os.environ["GS_SERVE_IDLE_S"] = "0.5"
+    try:
+        co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        server = StreamServer(co, port=0).start()
+        s, d = make_stream(2 * eb, vb, seed=71)
+        s, d = s.astype(np.int32), d.astype(np.int32)
+        slow = ServeClient(server.port, timeout=60)
+        assert slow.admit("t")["ok"]
+        assert slow.feed("t", s[:eb], d[:eb])["ok"]
+        shed = False
+        with faults.inject(faults.FaultSpec(
+                site="serve_send", on_call=1, action="hang",
+                seconds=2.0)):
+            try:
+                slow.pump()  # this response's send stalls → shed
+                raise SystemExit("chaos serve slow-client: the stall "
+                                 "never shed the connection")
+            except (ConnectionError, OSError):
+                shed = True
+        if not _ledger_has("serve_client_shed"):
+            raise SystemExit("chaos serve slow-client: no durable "
+                             "serve_client_shed event")
+        # the pump is NOT wedged: a fresh connection still serves
+        cli = ServeClient(server.port, timeout=60)
+        assert cli.feed("t", s[eb:], d[eb:])["ok"]
+        windows = len(cli.pump()["results"].get("t", []))
+        cli.close()
+        slow.close()
+        server.close()
+        if windows < 1:
+            raise SystemExit("chaos serve slow-client: the pump "
+                             "served nothing after the shed")
+        return {"parity": True, "shed": shed,
+                "windows_after_shed": windows}
+    finally:
+        if prev is None:
+            os.environ.pop("GS_SERVE_IDLE_S", None)
+        else:
+            os.environ["GS_SERVE_IDLE_S"] = prev
+
+
+def _serve_drain_subleg(workdir, np, streams, oracle, eb, vb,
+                        num_w) -> dict:
+    import signal
+    import subprocess
+    import time
+
+    from gelly_streaming_tpu.core.serve import ServeClient
+    from gelly_streaming_tpu.utils import wal as wal_mod
+
+    wal_dir = os.path.join(workdir, "drain_wal")
+    results = os.path.join(workdir, "drain_results.jsonl")
+    port_file = os.path.join(workdir, "drain_port.txt")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gelly_streaming_tpu.core.serve",
+         "--edge-bucket", str(eb), "--vertex-bucket", str(vb),
+         "--port", "0", "--port-file", port_file,
+         "--wal", wal_dir,
+         "--ckpt", os.path.join(workdir, "drain_ckpt"),
+         "--ckpt-every", "2", "--results", results],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    t0 = time.monotonic()
+    while not os.path.exists(port_file):
+        if proc.poll() is not None or time.monotonic() - t0 > 120:
+            raise SystemExit("chaos serve drain: server never came "
+                             "up:\n%s"
+                             % proc.communicate()[0].decode()[-2000:])
+        time.sleep(0.05)
+    with open(port_file) as f:
+        port = int(f.read().strip())
+    cli = ServeClient(port, timeout=60)
+    for tid in sorted(streams):
+        assert cli.admit(tid)["ok"]
+    for w in range(num_w):
+        for tid, (s, d) in sorted(streams.items()):
+            assert cli.feed(tid, s[w * eb:(w + 1) * eb].tolist(),
+                            d[w * eb:(w + 1) * eb].tolist())["ok"]
+    # SIGTERM lands while the last feeds are still queued/un-pumped —
+    # the graceful drain must finalize them, not lose them
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=180)
+    cli.close()
+    if proc.returncode != 0:
+        raise SystemExit("chaos serve drain: exit %d, want 0:\n%s"
+                         % (proc.returncode, out.decode()[-2000:]))
+    got = {}
+    with open(results) as f:
+        for line in f:
+            row = json.loads(line)
+            got.setdefault(row["tenant"], {})[row["window"]] \
+                = row["summary"]
+    final = {tid: [got[tid][k] for k in sorted(got[tid])]
+             for tid in got}
+    # the drain digest must equal the keep-running digest: every
+    # accepted window finalized, none lost (close() is not part of
+    # this schedule — full windows only, so the streams compare flat)
+    for tid in streams:
+        if final.get(tid) != oracle[tid]:
+            raise SystemExit(
+                "chaos serve drain DIVERGED for tenant %s: %d "
+                "windows vs %d" % (tid, len(final.get(tid, [])),
+                                   len(oracle[tid])))
+    info = wal_mod.scan(wal_dir)
+    if not info["sealed"]:
+        raise SystemExit("chaos serve drain: journal not sealed")
+    return {"parity": True, "rc": proc.returncode, "sealed": True,
+            "digest_match": True,
+            "windows": {tid: len(v) for tid, v in final.items()}}
+
+
 def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
              workdir: str) -> dict:
     """The mesh drill: a sharded driver on the virtual CPU mesh takes
@@ -922,15 +1285,20 @@ def main():
             # checkpoint resume; per-tenant digests equal the
             # fault-free sequential oracle
             tn = leg_tenancy(workdir)
+            # serve leg: the durable front-end — loopback kill →
+            # WAL-replay parity, torn journal tail falls back one
+            # record, slow client shed, SIGTERM drain exits 0 with a
+            # sealed journal (subprocess)
+            sv = leg_serve(workdir)
             # mesh leg: corrupt wire → retry, dead shard → demotion →
             # parity, n-shard checkpoint → 1-device + host-twin resume
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
                           args.mesh_devices, workdir)
                  if args.mesh_devices else None)
-            # flight-recorder leg: five kills fired above (driver,
-            # autotune, resident, engine, tenancy) — the ledger must
-            # prove all
-            fr = assert_flight_recorder(num_kills=5)
+            # flight-recorder leg: six kills fired above (driver,
+            # autotune, resident, engine, tenancy, serve) — the
+            # ledger must prove all
+            fr = assert_flight_recorder(num_kills=6)
             fr["span_summary"] = telemetry.summary(top=12)
         finally:
             telemetry.reset()  # close the ledger inside the tempdir
@@ -960,6 +1328,17 @@ def main():
         elif site == "cohort_dispatch" and action == "raise":
             classes.add("tenant_kill_resume")
     required |= {"tenant_demotion", "tenant_kill_resume"}
+    for site, _n, action in sv["kill"]["faults_fired"]:
+        if site == "cohort_dispatch" and action == "raise":
+            classes.add("serve_kill_replay")
+    if sv["torn_tail"]["parity"]:
+        classes.add("serve_torn_tail")
+    if sv["slow_client"]["shed"]:
+        classes.add("serve_slow_client_shed")
+    if sv["drain"]["rc"] == 0 and sv["drain"]["sealed"]:
+        classes.add("serve_sigterm_drain")
+    required |= {"serve_kill_replay", "serve_torn_tail",
+                 "serve_slow_client_shed", "serve_sigterm_drain"}
     if m is not None:
         for site, _n, action in m["faults_fired"]:
             if action == "corrupt_shard":
@@ -987,6 +1366,7 @@ def main():
         "resident_leg": rs,
         "health_leg": h,
         "tenancy_leg": tn,
+        "serve_leg": sv,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
         "gslint_leg": gl,

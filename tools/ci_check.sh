@@ -14,6 +14,12 @@
 #      cohort must be digest-identical to the single-stream engine,
 #      so the vmapped cohort path can't silently drift from the
 #      single-stream semantics
+#   6. serve parity smoke (tools/serve_smoke.py): one tenant fed
+#      through a real loopback socket into the journal-armed
+#      StreamServer (feed -> pump -> graceful drain) must be
+#      digest-identical to the direct cohort feed, with a sealed
+#      journal — the wire/durability layer changes availability,
+#      never results
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -22,24 +28,27 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/5] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/6] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/5] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/6] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/5] gslint =="
+echo "== [2/6] gslint =="
 python -m tools.gslint
 
-echo "== [3/5] perf_schema: committed PERF*/BENCH_* evidence =="
-evidence=(PERF*.json BENCH_*.json)
+echo "== [3/6] perf_schema: committed PERF*/BENCH_* evidence =="
+evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/5] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/6] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/5] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/6] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
+
+echo "== [6/6] serve parity smoke (loopback + drain ≡ direct feed) =="
+JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
 echo "ci_check: all gates green"

@@ -539,6 +539,10 @@ class ThreadSharedRule(Rule):
         PKG + "/utils/resilience.py",
         PKG + "/utils/faults.py",
         PKG + "/utils/interning.py",
+        # the serving front-end's connection/tail/pump threads and
+        # the journal they append through (ISSUE 12)
+        PKG + "/utils/wal.py",
+        PKG + "/core/serve.py",
     )
 
     def check_module(self, ctx: ModuleCtx) -> List[Finding]:

@@ -207,6 +207,72 @@ def is_capture(doc) -> bool:
         and ("cmd" in doc or "rc" in doc)
 
 
+# per-leg required keys of the chaos soak summary
+# (tools/chaos_run.py; every committed logs/CHAOS_*.json). Legs are
+# optional (older soaks predate newer legs) but a PRESENT leg must
+# carry its keys — a soak that "passed" without its parity flag is
+# exactly the silent-drift CI must refuse.
+_CHAOS_LEGS = {
+    "driver_leg": ("parity", "faults_fired", "resumed_from_window"),
+    "engine_leg": ("parity", "faults_fired", "killed_at_call"),
+    "resident_leg": ("parity", "faults_fired"),
+    "tenancy_leg": ("parity", "faults_fired", "resumed"),
+    # the durable-serving drill (ISSUE 12): kill→WAL-replay parity,
+    # torn tail falling back one record, slow-client shed, and the
+    # graceful SIGTERM drain (subprocess exits 0, sealed journal,
+    # drain digest ≡ keep-running digest)
+    "serve_leg": ("parity", "kill", "torn_tail", "slow_client",
+                  "drain"),
+}
+
+
+def is_chaos(doc) -> bool:
+    """True for the tools/chaos_run.py soak-summary shape, which
+    main() routes to validate_chaos."""
+    return isinstance(doc, dict) and "fault_classes_fired" in doc
+
+
+def validate_chaos(doc) -> list:
+    """Error strings for one parsed logs/CHAOS_*.json soak summary;
+    empty = clean."""
+    errors = []
+    if not isinstance(doc, dict):
+        return ["top level: expected a dict soak summary"]
+    if doc.get("parity") is not True:
+        errors.append("chaos: top-level 'parity' must be true — a "
+                      "diverged soak must never be committed")
+    if not isinstance(doc.get("fault_classes_fired"), list):
+        errors.append("chaos: 'fault_classes_fired' must be a list")
+    for leg, keys in _CHAOS_LEGS.items():
+        val = doc.get(leg)
+        if val is None:
+            continue  # legs are additive across soak generations
+        if not isinstance(val, dict):
+            errors.append("%s: expected a dict leg, got %s"
+                          % (leg, type(val).__name__))
+            continue
+        for key in keys:
+            if key not in val:
+                errors.append("%s: missing required key %r"
+                              % (leg, key))
+        if val.get("parity") is not True:
+            errors.append("%s: leg 'parity' must be true" % leg)
+    serve = doc.get("serve_leg")
+    if isinstance(serve, dict):
+        drain = serve.get("drain")
+        if isinstance(drain, dict):
+            for key in ("rc", "sealed", "digest_match"):
+                if key not in drain:
+                    errors.append("serve_leg.drain: missing required "
+                                  "key %r" % key)
+            if drain.get("rc") != 0:
+                errors.append("serve_leg.drain: SIGTERM drain must "
+                              "exit 0 (got %r)" % (drain.get("rc"),))
+        elif drain is not None:
+            errors.append("serve_leg.drain: expected a dict")
+    return errors
+
+
 def main(paths=None) -> int:
     paths = paths or [os.path.join(REPO, "PERF.json")]
     rc = 0
@@ -219,6 +285,7 @@ def main(paths=None) -> int:
             rc = 1
             continue
         errors = (validate_capture(perf) if is_capture(perf)
+                  else validate_chaos(perf) if is_chaos(perf)
                   else validate(perf))
         if errors:
             rc = 1
