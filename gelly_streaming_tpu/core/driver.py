@@ -367,6 +367,9 @@ class StreamingAnalyticsDriver:
         # would double-replay on recovery.
         self._wal = None
         self._wal_dir = None
+        # GS_WAL_RETAIN bookkeeping: journal truncation at checkpoint
+        # FLUSH boundaries, floored at the older kept generation
+        self._wal_retention = wal_mod.RetentionCursor()
         self._in_stream = 0
         # tier demotion (utils/resilience): a persistent device failure
         # in the batched snapshot path demotes scan→native→host
@@ -1829,6 +1832,9 @@ class StreamingAnalyticsDriver:
         if self._emitted is None:
             with self._step("checkpoint", 0):
                 checkpoint.save(self._ckpt_path, snap[1])
+            self._wal_retention.flushed(
+                self._wal, self.tenant or "driver",
+                int(snap[1]["wal_offset"]))
         else:
             self._pending_ckpt.append(snap)
 
@@ -1846,6 +1852,12 @@ class StreamingAnalyticsDriver:
             if flushed is not None:
                 with self._step("checkpoint", 0):
                     checkpoint.save(self._ckpt_path, flushed[1])
+                # journal-armed drivers refuse stream_file (so this
+                # flush site normally has no journal), but the
+                # retention contract holds wherever a flush lands
+                self._wal_retention.flushed(
+                    self._wal, self.tenant or "driver",
+                    int(flushed[1]["wal_offset"]))
 
     @contextlib.contextmanager
     def _batched_triangles(self):

@@ -233,6 +233,10 @@ class TenantCohort:
         self._round_no = 0
         self._wal = None           # utils/wal.WriteAheadLog when armed
         self._wal_dir = None
+        # GS_WAL_RETAIN bookkeeping: journal truncation at the
+        # checkpoint_all() flush boundary, floored per tenant at the
+        # older kept generation (utils/wal.RetentionCursor)
+        self._wal_retention = wal_mod.RetentionCursor()
 
     # ------------------------------------------------------------------
     # admission
@@ -893,6 +897,13 @@ class TenantCohort:
             if t.ckpt_policy is not None:
                 t.ckpt_policy.mark(t.windows_done)
             saved += 1
+        # journal retention at the flush boundary (GS_WAL_RETAIN):
+        # every tenant's floor moves in ONE truncate_covered call —
+        # a per-tenant call would see the other tenants' records as
+        # uncovered and never delete a shared segment
+        self._wal_retention.flushed_many(
+            self._wal, {tid: self.tenants[tid].windows_done * self.eb
+                        for tid in self.tenants})
         return saved
 
     def try_resume(self, tenant_id) -> bool:

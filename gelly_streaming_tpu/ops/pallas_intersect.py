@@ -68,11 +68,35 @@ def _resolve_tile():
                 and r.get("tile_e") and r.get("chunk_k")]
         if rows:
             best = min(rows, key=lambda r: r["ms"])
-            choice = (int(best["tile_e"]), int(best["chunk_k"]))
+            choice = (int(best["tile_e"]), int(best["chunk_k"]))  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
     except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
         pass
     _TILE_CHOICE = choice
     return choice
+
+
+def tile_intersect_count(ra, rb, va, chunk_k: int):
+    """The seed kernel's inner compare loop on one pre-gathered tile
+    pair — ra/rb: [T, K] int32 neighbor rows, va: [T, K] bool validity
+    of ra entries (sentinel/padding pre-masked) — as a plain traceable
+    function, so the fused window megakernel (ops/pallas_window.py)
+    runs the IDENTICAL K-bucket intersection inside its own
+    pallas_call: one [T, Ck, K] broadcast-equality chunk at a time,
+    never materializing more than a chunk in VMEM. Rows are
+    deduplicated, so each ra entry matches at most one rb entry and
+    the `any` over the compare axis counts it exactly once. Returns
+    the int32 tile total."""
+    k = ra.shape[1]
+    total = jnp.int32(0)
+    for c in range(-(-k // chunk_k)):
+        ck = min(chunk_k, k - c * chunk_k)
+        a_chunk = ra[:, c * chunk_k:c * chunk_k + ck]   # [T, Ck]
+        v_chunk = va[:, c * chunk_k:c * chunk_k + ck]
+        hit = jnp.any(
+            a_chunk[:, :, None] == rb[:, None, :], axis=2)
+        total += jnp.sum(jnp.where(hit & v_chunk, 1, 0),
+                         dtype=jnp.int32)
+    return total
 
 
 def _make_kernel(chunk_k: int):
@@ -81,19 +105,10 @@ def _make_kernel(chunk_k: int):
         of ra entries (sentinel/padding pre-masked). out: [g] int32
         partial counts in SMEM — the whole array is the block (a
         size-1 block per step is not lowerable on TPU), each grid step
-        writes its own slot."""
-        k = ra.shape[1]
-        rb_val = rb[:]                              # [T, K] in VMEM
-        total = jnp.int32(0)
-        for c in range(-(-k // chunk_k)):
-            ck = min(chunk_k, k - c * chunk_k)
-            a_chunk = ra[:, pl.ds(c * chunk_k, ck)]  # [T, Ck]
-            v_chunk = va[:, pl.ds(c * chunk_k, ck)]
-            hit = jnp.any(
-                a_chunk[:, :, None] == rb_val[:, None, :], axis=2)
-            total += jnp.sum(jnp.where(hit & v_chunk, 1, 0),
-                             dtype=jnp.int32)
-        out[pl.program_id(0)] = total
+        writes its own slot. The compare math lives in
+        tile_intersect_count, shared with the window megakernel."""
+        out[pl.program_id(0)] = tile_intersect_count(
+            ra[:], rb[:], va[:], chunk_k)
 
     return _intersect_kernel
 

@@ -107,8 +107,8 @@ def triangle_count_dense_pallas(src, dst, num_vertices: int) -> int:
 
     vb = seg_ops.bucket_size(num_vertices)
     eb = seg_ops.bucket_size(len(src))
-    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)
-    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)
+    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
     partials = _adjacency_six_t(jnp.asarray(s), jnp.asarray(d), vb,
                                 _need_interpret())
-    return int(np.asarray(partials).astype(np.int64).sum()) // 6
+    return int(np.asarray(partials).astype(np.int64).sum()) // 6  # gslint: disable=host-sync (sanctioned result boundary: the dense count's ONE d2h)

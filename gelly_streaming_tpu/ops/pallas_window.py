@@ -1,0 +1,757 @@
+"""Fused window megakernel: ONE VMEM-tiled Pallas pass per edge slab,
+shared across all analytics.
+
+The dispatch observatory's committed cost-model rows (PERF_cpu.json
+`cost_model`, ISSUE 10) prove every hot program — the fused scan, the
+resident fused-compact super-batch, the triangle stream — is
+bytes-bound at 0.25-0.28 FLOPs/byte with the fused scan at 0.096% of
+roofline, because XLA's scan-of-gathers re-reads the COO edge slab
+from HBM once per analytic: the degree fold, the CC fixpoint, the
+double-cover fixpoint (twice — its edge list is the concatenated
+cover), and the triangle stage each gather the same [eb] src/dst
+arrays. This module is the IO-aware fix the PAPERS.md GNN-systems
+literature prescribes: fuse compact-ingress decode → vertex bucketing
+→ neighbor intersection → monoid reduce into a single `pallas_call`
+per window, so the slab leaves HBM ONCE and every analytic (CC
+union-find labels, bipartiteness sign, degree counts, triangle
+counts) is computed from the VMEM-resident copy.
+
+Kernel shape (grown from the two seeds, ops/pallas_intersect.py /
+ops/pallas_triangles.py):
+
+- grid = (eb // tile_e,) edge tiles; each step's [1, tile_e] src/dst
+  (uint16 on the compact wire) blocks stream HBM→VMEM under Pallas's
+  own double-buffered block pipeline — the tentpole's "edge-tile
+  double-buffered copy into VMEM".
+- per tile: compact decode (suffix mask from the window's valid
+  count + uint16→int32 widening — the exact widen_stack semantics,
+  fused instead of materialized), the degree monoid fold into the
+  VMEM-resident carry slab, and the decoded tile staged into a VMEM
+  slab scratch.
+- the LAST tile runs the remaining analytics on the now
+  VMEM-resident slab: the carried CC and double-cover min-label
+  fixpoints (ops/unionfind.cc_fixpoint — composition over any edge
+  partition converges to the same canonical labeling, so folding at
+  window grain is bit-exact), then the triangle stage —
+  build_window_counter's exact pipeline (orient_by_degree →
+  dedupe_and_positions → K-bucket CSR scatter) with the K-bucket
+  intersection running the intersect seed's OWN inner compare loop
+  (pallas_intersect.tile_intersect_count) over bounded edge tiles.
+- outputs: the three carry slabs plus one [8]-scalar SMEM summary
+  row (max_degree, num_components, odd, triangles, K-overflow) —
+  K-overflow hands off to the call sites' existing exact-redo
+  escalation, so exactness is never sacrificed.
+
+Selection is the repo's measured-adoption gate (`resolve_*` family):
+`GS_PALLAS_WINDOW` pins on/off; unset/`auto` adopts ONLY on committed
+backend-matched `pallas_ab` rows (tools/pallas_ab.py) that all show
+exact parity and ≥1.05×, so the XLA fused scan stands — and CPU
+digests stay bit-identical — until a chip row lands. A pallas_call
+that raises at build/trace time (Pallas API drift, a Mosaic lowering
+gap for the in-kernel sort/scatter) degrades to the XLA body with a
+durable `selection.fallback` event instead of taking the stream down
+— the same honest fallback every other selection plays.
+
+Off-TPU the kernel runs in INTERPRET mode (the seeds' convention):
+bit-identical to the XLA scan and the host twins by construction —
+that is tier-1's parity oracle (tests/operations/
+test_pallas_window.py, ci_check gate 7) — but it times nothing real,
+so interpret rows can never clear the adoption bar. Interpret also
+unrolls the grid at trace time, so off-TPU the default edge tile is
+the whole slab (one grid step keeps the jaxpr linear); the tiled
+path is exercised by tests at small buckets and is the shape the
+chip session tunes (`pallas_window` DispatchTuner family: edge-tile
+× K-chunk arms).
+
+VMEM budget (the `supports()` gate, enforced on TPU backends only —
+interpret has no VMEM): slab 2·4·eb + carry in/out 2·16·(vb+1) +
+K-bucket table 4·(vb+1)·kb + the bounded [it, ck, kb] compare block
++ sort temps must fit under ~12MB of the 16MB scoped VMEM. At the
+canonical eb=32768 / vb=65536 / kb=32 row the table alone is 8.4MB —
+chip adoption at wide vertex buckets wants kb ≤ 16 or vb ≤ 32768;
+see DESIGN.md §19 for the arithmetic.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import pallas_intersect
+from . import triangles as tri_ops
+from .pallas_triangles import _need_interpret
+from ..utils import costmodel
+from ..utils import knobs
+from ..utils import telemetry
+
+# default edge tile on a real chip; off-TPU the interpreter unrolls
+# the grid at trace time, so the default degenerates to one whole-slab
+# step (see default_tile)
+TILE_E = 512
+# the intersect stage's inner edge tile: bounds the [it, ck, kb]
+# broadcast-compare block whatever the staging tile is (the seed's
+# scoped-vmem lesson: T=256/Ck=128/K=256 already flirts with the 16M
+# limit)
+INTERSECT_TILE = 2048
+# VMEM ceiling supports() enforces on TPU backends (headroom under the
+# 16MB scoped-vmem limit for Mosaic's own temporaries)
+VMEM_BUDGET = 12 * 1024 * 1024
+
+_SUMS = 8  # [8]-int32 summary row (5 used; padded for alignment)
+
+_CALLS = {}   # (eb,vb,kb,tile,ck,kind,interpret) -> pallas_call closure  # gslint: disable=thread-shared (idempotent memo: same key always builds the same program; a racing double-build is last-write-wins)
+_PROBES = {}  # (vb,kb,kind) -> bool probe verdict  # gslint: disable=thread-shared (idempotent memo of a deterministic trace probe)
+
+
+# ----------------------------------------------------------------------
+# selection gate (the resolve_* family)
+# ----------------------------------------------------------------------
+_PALLAS = None  # "pallas" | "xla", resolved once per process
+
+
+def _reset_pallas_window() -> None:
+    """Test hook: forget the memoized selection and probe verdicts."""
+    global _PALLAS
+    _PALLAS = None
+    _PROBES.clear()
+
+
+def resolve_pallas_window() -> bool:
+    """Should the fused-scan/triangle window bodies run the Pallas
+    megakernel instead of the XLA scan-of-gathers? GS_PALLAS_WINDOW
+    pins (`on`/`off`); unset/`auto` adopts only when committed
+    backend-matched `pallas_ab` rows (tools/pallas_ab.py) ALL show
+    exact parity and ≥1.05× (ops/triangles.rows_clear_bar — the
+    repo-wide measured-adoption policy). Interpret-mode rows can
+    never clear that bar, so CPU behavior stays bit-identical until
+    a chip row lands. Memoized per process."""
+    global _PALLAS
+    pin = knobs.get_str("GS_PALLAS_WINDOW")
+    if pin == "on":
+        return True
+    if pin == "off":
+        return False
+    if _PALLAS is None:
+        impl = "xla"
+        try:
+            perf = tri_ops._load_matching_perf()
+            if tri_ops.rows_clear_bar(
+                    (perf or {}).get("pallas_ab", []),
+                    "speedup", lambda r: 1.0):
+                impl = "pallas"
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="pallas_window", fallback=impl,
+                            error="%s: %s" % (type(e).__name__, e))
+        _PALLAS = impl
+    return _PALLAS == "pallas"
+
+
+# ----------------------------------------------------------------------
+# tiling layer (shared with the seeds' committed-evidence policy)
+# ----------------------------------------------------------------------
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # gslint: disable=except-hygiene (availability probe: selects the interpret form, never correctness)
+        return False
+
+
+def default_tile(eb: int) -> int:
+    """Edge tile when nothing pins one: min(512, eb) on a chip (the
+    seed's measured ballpark); the WHOLE slab off-TPU — interpret
+    mode unrolls the grid at trace time, so one step keeps the jaxpr
+    linear in eb instead of quadratic-ish in tiles."""
+    return min(TILE_E, eb) if _on_tpu() else eb
+
+
+def tile_space(eb: int, kb: int) -> dict:
+    """The `pallas_window` DispatchTuner arm space: edge-tile rungs
+    under the slab size × K-chunk widths under the K bucket. Off-TPU
+    the only tile is the whole slab — interpret mode unrolls the grid
+    at trace time, so sub-slab tiles there trace the final stage once
+    PER TILE and wedge the host compiler, measuring nothing a chip
+    would ever run."""
+    tiles = sorted({t for t in (256, 512, 1024, 2048) if t <= eb}
+                   or {eb}) if _on_tpu() else [eb]
+    cks = sorted({min(64, kb), min(128, kb)})
+    return {"tile_e": tiles, "ck": cks}
+
+
+def tile_tuner(eb: int, vb: int, kb: int):
+    """The megakernel's autotuner family riding ops/autotune
+    .DispatchTuner: `pallas_window:eb=…:vb=…:kb=…` with edge-tile ×
+    K-chunk arms. tools/pallas_ab.py --sweep drives rounds offline
+    (each arm is a distinct compiled program, so arms are explored
+    between streams, not mid-stream); the persisted per-backend cache
+    then seeds resolve_tiles for production builds."""
+    from . import autotune
+
+    space = tile_space(eb, kb)
+    init = {"tile_e": (min(TILE_E, eb) if min(TILE_E, eb)
+                       in space["tile_e"] else space["tile_e"][-1]),
+            "ck": space["ck"][-1]}
+    return autotune.DispatchTuner(tuner_key(eb, vb, kb), space, init)
+
+
+def tuner_key(eb: int, vb: int, kb: int) -> str:
+    return "pallas_window:eb=%d:vb=%d:kb=%d" % (eb, vb, kb)
+
+
+def resolve_tiles(eb: int, kb: int, vb: int = 0,
+                  tile_e: int = None, chunk_k: int = None):
+    """(tile_e, ck) the megakernel builds at: explicit arguments (the
+    A/B sweep) beat the GS_PALLAS_TILE/GS_PALLAS_CK pins beat the
+    `pallas_window` tuner's persisted optimum for this shape beat the
+    defaults — the same committed-evidence ladder as the intersect
+    seed's _resolve_tile. Called at BUILD time only (knob reads must
+    not freeze inside a traced body)."""
+    if tile_e is None:
+        tile_e = knobs.get_int("GS_PALLAS_TILE") or 0
+    if chunk_k is None:
+        chunk_k = knobs.get_int("GS_PALLAS_CK") or 0
+    if (not tile_e or not chunk_k) and vb:
+        try:
+            from . import autotune
+
+            cached = autotune.load_cached_best(tuner_key(eb, vb, kb))
+            if cached:
+                arm = cached.get("arm") or {}
+                tile_e = tile_e or int(arm.get("tile_e") or 0)  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
+                chunk_k = chunk_k or int(arm.get("ck") or 0)  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
+        except Exception:  # gslint: disable=except-hygiene (committed-evidence probe: absence/corruption selects the proven default)
+            pass
+    tile_e = tile_e or default_tile(eb)
+    tile_e = max(8, min(tile_e, eb))
+    while eb % tile_e:
+        tile_e //= 2
+    chunk_k = max(8, min(chunk_k or min(128, kb), kb))
+    return tile_e, chunk_k
+
+
+# ----------------------------------------------------------------------
+# VMEM budget + analytic cost model
+# ----------------------------------------------------------------------
+def slab_bytes(eb: int, compact: bool = False) -> int:
+    """HBM bytes of ONE edge-slab read: 4 bytes/slot on the compact
+    wire (2×uint16 + the per-window valid count), 9 on the standard
+    wire (2×int32 + the bool mask)."""
+    return eb * (2 + 2) + 4 if compact else eb * (4 + 4 + 1)
+
+
+def carry_bytes(vb: int) -> int:
+    """Bytes of one carry copy (degrees + labels + double cover)."""
+    return 4 * ((vb + 1) + (vb + 1) + 2 * (vb + 1))
+
+
+def window_bytes(eb: int, vb: int, compact: bool = False) -> int:
+    """The megakernel's HBM traffic per window: ONE slab read, the
+    carry read+write, the summary row."""
+    return slab_bytes(eb, compact) + 2 * carry_bytes(vb) + 4 * _SUMS
+
+
+def scan_of_gathers_bytes(eb: int, vb: int,
+                          analytics: int = 4) -> int:
+    """HBM bytes the XLA scan-of-gathers moves for the SAME window:
+    each analytic re-gathers the standard-wire slab — degrees once,
+    CC once, the double cover twice (its edge list is the
+    concatenated cover), triangles once — plus the same carry
+    read+write. The adoption story in one subtraction: the megakernel
+    replaces `reads × slab` with `1 × slab`."""
+    reads = {1: 1, 2: 2, 3: 4}.get(analytics, 5)
+    return reads * slab_bytes(eb, False) + 2 * carry_bytes(vb)
+
+
+def window_flops(eb: int, vb: int, kb: int) -> int:
+    """Stated-model FLOP estimate per window (labeled `analytic` in
+    the cost registry — a model, not a compiler measurement): the
+    K-bucket compare dominates (2·eb·kb), plus the monoid folds, a
+    nominal 8-round fixpoint over the three slabs, and the dedupe
+    sort's eb·log2(eb) compares."""
+    fix = 8 * (4 * (vb + 1))
+    return (2 * eb * kb + 16 * eb + 3 * fix
+            + eb * int(math.log2(max(eb, 2))))  # gslint: disable=host-sync (python-int bucket math, no device value in sight)
+
+
+def vmem_window_bytes(eb: int, vb: int, kb: int,
+                      tile_e: int = None, ck: int = None,
+                      compact: bool = False) -> int:
+    """The kernel's VMEM high-water estimate (DESIGN.md §19 walks the
+    arithmetic): decoded slab scratch + carry in/out blocks + the
+    K-bucket table + the bounded intersect compare block + the dedupe
+    sort's temporaries."""
+    if tile_e is None or ck is None:
+        tile_e, ck = resolve_tiles(eb, kb)
+    it = min(tile_e, INTERSECT_TILE, eb)
+    slab = 2 * 4 * eb
+    carry = 2 * carry_bytes(vb)
+    nbr = 4 * (vb + 1) * kb
+    compare = 2 * 4 * it * kb + it * min(ck, kb) * kb
+    sort_tmp = 6 * 4 * eb
+    return slab + carry + nbr + compare + sort_tmp
+
+
+def supports(eb: int, vb: int, kb: int, tile_e: int = None,
+             ck: int = None, compact: bool = False) -> bool:
+    """Does this (eb, vb, kb) fit the chip's VMEM budget? Enforced on
+    TPU backends only — interpret mode has no VMEM, and refusing a
+    CPU parity run over a budget the backend doesn't have would gate
+    the oracle out of existence."""
+    if not _on_tpu():
+        return True
+    return vmem_window_bytes(eb, vb, kb, tile_e, ck,
+                             compact) <= VMEM_BUDGET
+
+
+def register_cost_model(eb: int, vb: int, kb: int,
+                        compact: bool = False) -> None:
+    """Register the megakernel's analytic cost model with the
+    observatory (utils/costmodel.record_analytic, armed only), under
+    EVERY program label this body can dispatch as — the scan engine's
+    and the resident tier's wrap_jit names — so the ledger spans join
+    the stated model (one slab read vs the scan-of-gathers' summed
+    reads) at their own abstract signatures, never a compiler capture
+    of the interpret lowering. explain_perf then reports achieved
+    GB/s and roofline fraction for the new program on any backend.
+    The template carries the most recent registration's shape — one
+    engine shape per program per process is the operating regime."""
+    wire = "compact" if compact else "standard"
+    programs = (("pallas_window_compact", "resident_pallas_compact")
+                if compact else ("pallas_window", "resident_pallas"))
+    for program in programs:
+        costmodel.record_analytic(
+            program,
+            "eb=%d,vb=%d,kb=%d,%s" % (eb, vb, kb, wire),
+            flops=window_flops(eb, vb, kb),
+            bytes_accessed=window_bytes(eb, vb, compact),
+            slab_bytes=slab_bytes(eb, compact),
+            scan_of_gathers_bytes=scan_of_gathers_bytes(eb, vb),
+            model="analytic",
+            # the model is PER WINDOW; a chunk dispatch folds W of
+            # them, so a reader scaling against per-dispatch span
+            # seconds multiplies by the sig's leading window count
+            unit="window")
+
+
+# ----------------------------------------------------------------------
+# the kernel
+# ----------------------------------------------------------------------
+def _tri_stage(sa, da, va, vb: int, kb: int, it: int, ck: int):
+    """build_window_counter's EXACT per-window triangle pipeline on
+    the VMEM-resident slab — same cleanup, orientation
+    (tri_ops.orient_by_degree), fused dedupe+CSR positions
+    (tri_ops.dedupe_and_positions), K-bucket scatter, and overflow
+    accounting, so counts and the K-overflow handoff are
+    bit-identical to the XLA body by construction. The K-bucket
+    intersection runs the intersect seed's inner compare loop
+    (pallas_intersect.tile_intersect_count) over `it`-edge tiles:
+    per-tile [it, kb] row gathers + one [it, ck, kb] compare block —
+    never more than a tile of rows in flight."""
+    sent = vb
+    valid = va & (sa != da)
+    s = jnp.where(valid, sa, sent)
+    d = jnp.where(valid, da, sent)
+    ones = jnp.where(valid, 1, 0)
+    deg = jax.ops.segment_sum(ones, s, vb + 1)
+    deg = deg + jax.ops.segment_sum(ones, d, vb + 1)
+    a, b = tri_ops.orient_by_degree(s, d, deg, sent)
+    a, b, evalid, pos = tri_ops.dedupe_and_positions(a, b, sent, vb)
+    overflow = jnp.sum((pos >= kb) & evalid)
+    ok = evalid & (pos < kb)
+    rows = jnp.where(ok, a, vb)
+    cols = jnp.clip(pos, 0, kb - 1)
+    nbr = jnp.full((vb + 1, kb), sent, jnp.int32)
+    nbr = nbr.at[rows, cols].set(
+        jnp.where(ok, b, sent).astype(jnp.int32))
+    eb = sa.shape[0]
+    a32, b32 = a.astype(jnp.int32), b.astype(jnp.int32)
+    count = jnp.int32(0)
+    for t in range(0, eb, it):
+        hi = min(t + it, eb)
+        ra = nbr[a32[t:hi]]
+        rb = nbr[b32[t:hi]]
+        va_t = (ra < sent) & evalid[t:hi, None]
+        count = count + pallas_intersect.tile_intersect_count(
+            ra, rb, va_t, ck)
+    return count, overflow
+
+
+def _final_summaries(vb, deg, lab, cov):
+    """The per-window summary scalars off the folded carries — the
+    same expressions as scan_analytics._build_scan's body."""
+    touched = deg[:vb] > 0
+    mdeg = jnp.max(deg[:vb])
+    iota_v = jax.lax.broadcasted_iota(jnp.int32, (vb, 1), 0)[:, 0]
+    ncomp = jnp.sum(touched & (lab[:vb] == iota_v), dtype=jnp.int32)
+    odd = jnp.any(touched & (cov[:vb] == cov[vb + 1:2 * vb + 1]))
+    return mdeg, ncomp, odd
+
+
+def _pack_sums(*vals):
+    out = list(vals) + [jnp.int32(0)] * (_SUMS - len(vals))
+    return jnp.stack([jnp.asarray(v, jnp.int32) for v in out])
+
+
+def _window_call(eb: int, vb: int, kb: int, tile_e: int, ck: int,
+                 compact: bool, interpret: bool):
+    """The full megakernel pallas_call closure:
+    (deg, lab, cov, *wire) -> (deg, lab, cov, sums[8]). Memoized per
+    shape; `wire` is (s2, d2, v2) [g, tile_e] on the standard wire or
+    (nv[1], s16, d16) on the compact wire."""
+    key = (eb, vb, kb, tile_e, ck, "c" if compact else "s", interpret)
+    got = _CALLS.get(key)
+    if got is not None:
+        return got
+    from . import unionfind as uf
+
+    g = eb // tile_e
+    sent = vb
+    it = min(tile_e, INTERSECT_TILE, eb)
+
+    def _fold_tile(i, s, d, v, deg_ref, slab_s, slab_d):
+        ones = jnp.where(v, 1, 0)
+        deg_ref[:] = deg_ref[:].at[s].add(ones).at[d].add(ones)
+        slab_s[i, :] = s
+        slab_d[i, :] = d
+
+    def _final(deg_ref, lab_ref, cov_ref, sums_ref, slab_s, slab_d):
+        sa = slab_s[:].reshape(eb)
+        da = slab_d[:].reshape(eb)
+        va = sa != sent
+        lab = uf.cc_fixpoint(lab_ref[:], sa, da)
+        lab_ref[:] = lab
+        cov = uf.cc_fixpoint(
+            cov_ref[:], jnp.concatenate([sa, sa + (vb + 1)]),
+            jnp.concatenate([da + (vb + 1), da]))
+        cov_ref[:] = cov
+        mdeg, ncomp, odd = _final_summaries(vb, deg_ref[:], lab, cov)
+        tri, ovf = _tri_stage(sa, da, va, vb, kb, it, ck)
+        sums_ref[:] = _pack_sums(mdeg, ncomp,
+                                 jnp.where(odd, 1, 0), tri, ovf)
+
+    def _init(i, deg0, lab0, cov0, deg_ref, lab_ref, cov_ref):
+        @pl.when(i == 0)
+        def _():
+            deg_ref[:] = deg0[:]
+            lab_ref[:] = lab0[:]
+            cov_ref[:] = cov0[:]
+
+    if compact:
+        def kernel(nv_ref, s_ref, d_ref, deg0, lab0, cov0,
+                   deg_ref, lab_ref, cov_ref, sums_ref,
+                   slab_s, slab_d):
+            i = pl.program_id(0)
+            _init(i, deg0, lab0, cov0, deg_ref, lab_ref, cov_ref)
+            # compact-ingress decode, fused: the window's suffix mask
+            # from its valid count + uint16→int32 widening (the
+            # widen_stack semantics, per tile in VMEM)
+            pos = i * tile_e + jax.lax.broadcasted_iota(
+                jnp.int32, (1, tile_e), 1)[0]
+            v = pos < nv_ref[0]
+            s = jnp.where(v, s_ref[0, :].astype(jnp.int32), sent)
+            d = jnp.where(v, d_ref[0, :].astype(jnp.int32), sent)
+            _fold_tile(i, s, d, v, deg_ref, slab_s, slab_d)
+
+            @pl.when(i == g - 1)
+            def _():
+                _final(deg_ref, lab_ref, cov_ref, sums_ref,
+                       slab_s, slab_d)
+
+        wire_specs = [
+            pl.BlockSpec((1,), lambda i: (0,),
+                         memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+    else:
+        def kernel(s_ref, d_ref, v_ref, deg0, lab0, cov0,
+                   deg_ref, lab_ref, cov_ref, sums_ref,
+                   slab_s, slab_d):
+            i = pl.program_id(0)
+            _init(i, deg0, lab0, cov0, deg_ref, lab_ref, cov_ref)
+            v = v_ref[0, :]
+            s = jnp.where(v, s_ref[0, :], sent)
+            d = jnp.where(v, d_ref[0, :], sent)
+            _fold_tile(i, s, d, v, deg_ref, slab_s, slab_d)
+
+            @pl.when(i == g - 1)
+            def _():
+                _final(deg_ref, lab_ref, cov_ref, sums_ref,
+                       slab_s, slab_d)
+
+        wire_specs = [
+            pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+
+    vb1 = vb + 1
+    carry_specs = [
+        pl.BlockSpec((vb1,), lambda i: (0,),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((vb1,), lambda i: (0,),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((2 * vb1,), lambda i: (0,),
+                     memory_space=pltpu.VMEM),
+    ]
+    call = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=wire_specs + carry_specs,
+        out_specs=carry_specs + [
+            pl.BlockSpec((_SUMS,), lambda i: (0,),
+                         memory_space=pltpu.SMEM)],
+        out_shape=[
+            jax.ShapeDtypeStruct((vb1,), jnp.int32),
+            jax.ShapeDtypeStruct((vb1,), jnp.int32),
+            jax.ShapeDtypeStruct((2 * vb1,), jnp.int32),
+            jax.ShapeDtypeStruct((_SUMS,), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((g, tile_e), jnp.int32),
+                        pltpu.VMEM((g, tile_e), jnp.int32)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=window_flops(eb, vb, kb),
+            bytes_accessed=window_bytes(eb, vb, compact),
+            transcendentals=0),
+    )
+
+    def run(deg, lab, cov, *wire):
+        return call(*wire, deg, lab, cov)
+
+    _CALLS[key] = run
+    return run
+
+
+def _counter_call(eb: int, vb: int, kb: int, tile_e: int, ck: int,
+                  interpret: bool):
+    """Triangle-only megakernel (the stream kernel's per-window body
+    carries no analytics state): stage the slab tile by tile, run the
+    triangle stage at the last tile, emit (count, overflow)."""
+    key = (eb, vb, kb, tile_e, ck, "t", interpret)
+    got = _CALLS.get(key)
+    if got is not None:
+        return got
+    g = eb // tile_e
+    sent = vb
+    it = min(tile_e, INTERSECT_TILE, eb)
+
+    def kernel(s_ref, d_ref, v_ref, sums_ref, slab_s, slab_d):
+        i = pl.program_id(0)
+        v = v_ref[0, :]
+        slab_s[i, :] = jnp.where(v, s_ref[0, :], sent)
+        slab_d[i, :] = jnp.where(v, d_ref[0, :], sent)
+
+        @pl.when(i == g - 1)
+        def _():
+            sa = slab_s[:].reshape(eb)
+            da = slab_d[:].reshape(eb)
+            tri, ovf = _tri_stage(sa, da, sa != sent, vb, kb, it, ck)
+            sums_ref[:] = _pack_sums(tri, ovf)
+
+    tile_spec = pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    call = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[tile_spec, tile_spec, tile_spec],
+        out_specs=pl.BlockSpec((_SUMS,), lambda i: (0,),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((_SUMS,), jnp.int32),
+        scratch_shapes=[pltpu.VMEM((g, tile_e), jnp.int32),
+                        pltpu.VMEM((g, tile_e), jnp.int32)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=window_flops(eb, vb, kb),
+            bytes_accessed=slab_bytes(eb) + 4 * _SUMS,
+            transcendentals=0),
+    )
+    _CALLS[key] = call
+    return call
+
+
+# ----------------------------------------------------------------------
+# scan-body builders (the _build_scan-compatible contract)
+# ----------------------------------------------------------------------
+def build_window_body(eb: int, vb: int, kb: int, tile_e: int = None,
+                      chunk_k: int = None, compact: bool = False,
+                      interpret: bool = None):
+    """The megakernel as a drop-in scan body for
+    scan_analytics._build_scan: body(carry, xs) with the identical
+    carry layout ((deg[vb+1], labels[vb+1], cover[2(vb+1)])) and
+    per-window outputs (max_degree, num_components, odd, triangles,
+    K-overflow). `xs` is (src, dst, valid) rows on the standard wire
+    or (s16, d16, nvalid-scalar) on the compact wire — the compact
+    body consumes the RAW uint16 stacks, decode fused in-kernel."""
+    tile_e, ck = resolve_tiles(eb, kb, vb, tile_e, chunk_k)
+    if interpret is None:
+        interpret = _need_interpret()
+    run = _window_call(eb, vb, kb, tile_e, ck, compact, interpret)
+    g = eb // tile_e
+
+    if compact:
+        def body(carry, xs):
+            deg, lab, cov = carry
+            s16, d16, nv = xs
+            deg, lab, cov, sums = run(
+                deg, lab, cov,
+                jnp.reshape(nv, (1,)).astype(jnp.int32),
+                s16.reshape(g, tile_e), d16.reshape(g, tile_e))
+            return (deg, lab, cov), (sums[0], sums[1], sums[2] != 0,
+                                     sums[3], sums[4])
+    else:
+        def body(carry, xs):
+            deg, lab, cov = carry
+            src, dst, valid = xs
+            deg, lab, cov, sums = run(
+                deg, lab, cov, src.reshape(g, tile_e),
+                dst.reshape(g, tile_e), valid.reshape(g, tile_e))
+            return (deg, lab, cov), (sums[0], sums[1], sums[2] != 0,
+                                     sums[3], sums[4])
+
+    body.pallas_window = True
+    return body
+
+
+def maybe_window_body(eb: int, vb: int, kb: int,
+                      compact: bool = False):
+    """The gated, PROBED entry the engines build through: None (use
+    the XLA body) unless the selection gate is on, the shape fits the
+    chip budget, AND a trace probe of the built body succeeds. A
+    pallas_call that raises at trace time — Pallas API drift, a
+    lowering gap — degrades here with a durable `selection.fallback`
+    event instead of wedging engine construction (the chaos-leg
+    contract). On success the analytic cost entry registers with the
+    observatory."""
+    if not resolve_pallas_window():
+        return None
+    tile_e, ck = resolve_tiles(eb, kb, vb)
+    if not supports(eb, vb, kb, tile_e, ck, compact):
+        telemetry.event("selection.fallback", durable=True,
+                        component="pallas_window",
+                        fallback="xla_scan",
+                        error="vmem budget: %d > %d at eb=%d vb=%d "
+                              "kb=%d" % (
+                                  vmem_window_bytes(eb, vb, kb,
+                                                    tile_e, ck),
+                                  VMEM_BUDGET, eb, vb, kb))
+        return None
+    try:
+        body = build_window_body(eb, vb, kb, tile_e, ck, compact)
+        carry = (jax.ShapeDtypeStruct((vb + 1,), jnp.int32),
+                 jax.ShapeDtypeStruct((vb + 1,), jnp.int32),
+                 jax.ShapeDtypeStruct((2 * (vb + 1),), jnp.int32))
+        if compact:
+            xs = (jax.ShapeDtypeStruct((eb,), jnp.uint16),
+                  jax.ShapeDtypeStruct((eb,), jnp.uint16),
+                  jax.ShapeDtypeStruct((), jnp.int32))
+        else:
+            xs = (jax.ShapeDtypeStruct((eb,), jnp.int32),
+                  jax.ShapeDtypeStruct((eb,), jnp.int32),
+                  jax.ShapeDtypeStruct((eb,), jnp.bool_))
+        jax.eval_shape(body, carry, xs)
+    except Exception as e:
+        telemetry.event("selection.fallback", durable=True,
+                        component="pallas_window",
+                        fallback="xla_scan",
+                        error="%s: %s" % (type(e).__name__,
+                                          str(e)[:200]))
+        return None
+    register_cost_model(eb, vb, kb, compact)
+    return body
+
+
+def maybe_compact_scan_fn(eb: int, vb: int, kb: int, label: str,
+                          jit_kwargs: dict = None):
+    """The compact-fused scan program BOTH summary engines'
+    `_ensure_compact_fn` build when the megakernel is selected —
+    decode per tile in-kernel, scanned over the raw uint16 stacks —
+    factored here so the scan tier and the (donated) resident tier
+    can never diverge on the wiring. None when the compact body's
+    gate/probe refuses (callers fall back to the widen_stack twin)."""
+    cbody = maybe_window_body(eb, vb, kb, compact=True)
+    if cbody is None:
+        return None
+    from ..utils import metrics
+
+    def run_pc(carry, s16, d16, nvalid):
+        return jax.lax.scan(cbody, carry, (s16, d16, nvalid))
+
+    return metrics.wrap_jit(label, jax.jit(run_pc,
+                                           **(jit_kwargs or {})))
+
+
+def maybe_counter(vb: int, kb: int, classic_run):
+    """The gated triangle-stream variant for
+    triangles.build_window_counter: a selector body that runs the
+    triangle-only megakernel where the (trace-static) edge bucket
+    fits the budget and the probed kernel built, else `classic_run`.
+    The probe runs ONCE per (vb, kb) at a nominal bucket — the same
+    durable-fallback contract as maybe_window_body."""
+    if not resolve_pallas_window():
+        return None
+    pkey = (vb, kb, "counter")
+    verdict = _PROBES.get(pkey)
+    if verdict is None:
+        try:
+            probe_eb = 128
+            tile_e, ck = resolve_tiles(probe_eb, kb, vb)
+            call = _counter_call(probe_eb, vb, kb, tile_e, ck,
+                                 _need_interpret())
+            g = probe_eb // tile_e
+            sds = jax.ShapeDtypeStruct((g, tile_e), jnp.int32)
+            jax.eval_shape(call, sds, sds,
+                           jax.ShapeDtypeStruct((g, tile_e),
+                                                jnp.bool_))
+            verdict = True
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="pallas_window",
+                            fallback="xla_counter",
+                            error="%s: %s" % (type(e).__name__,
+                                              str(e)[:200]))
+            verdict = False
+        _PROBES[pkey] = verdict
+    if not verdict:
+        return None
+    # the stream program's stated model: slab in, two scalars out (no
+    # carried analytics); joins the pallas_window_stream spans the
+    # AOT wrapper tags
+    costmodel.record_analytic(
+        "pallas_window_stream", "vb=%d,kb=%d" % (vb, kb),
+        flops=None, bytes_accessed=None, model="analytic",
+        unit="window",
+        note="per-window bytes = pallas_window.slab_bytes(eb) + 32; "
+             "flops = window_flops(eb, vb, kb) triangle terms")
+    pin_tile = knobs.get_int("GS_PALLAS_TILE") or 0
+    pin_ck = knobs.get_int("GS_PALLAS_CK") or 0
+    interpret = _need_interpret()
+
+    def run(src, dst, valid):
+        # tile resolution here is PURE in (eb, the build-time pins):
+        # the edge bucket is trace-static, and the knob reads already
+        # happened at build — nothing environmental freezes in-trace
+        eb = src.shape[0]
+        tile_e = max(8, min(pin_tile or default_tile(eb), eb))
+        while eb % tile_e:
+            tile_e //= 2
+        ck = max(8, min(pin_ck or min(128, kb), kb))
+        if not supports(eb, vb, kb, tile_e, ck):
+            return classic_run(src, dst, valid)
+        call = _counter_call(eb, vb, kb, tile_e, ck, interpret)
+        g = eb // tile_e
+        sums = call(src.reshape(g, tile_e), dst.reshape(g, tile_e),
+                    valid.reshape(g, tile_e))
+        return sums[0], sums[1]
+
+    run.pallas_window = True
+    return run

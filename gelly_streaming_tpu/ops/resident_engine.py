@@ -352,8 +352,13 @@ class ResidentSummaryEngine(scan_analytics.StreamSummaryEngine):
         # the resident super-batch program's FLOPs/bytes land in the
         # cost registry per signature, and armed dispatches tag their
         # spans program="resident_fused"/sig for the attribution join
+        # — "resident_pallas" when the selected body is the fused
+        # window megakernel (ops/pallas_window), so the observatory
+        # attributes the new program distinctly on this tier too
+        self._pallas = bool(getattr(body, "pallas_window", False))
         self._run = metrics.wrap_jit(
-            "resident_fused", jax.jit(run, **donate_kw()))
+            "resident_pallas" if self._pallas else "resident_fused",
+            jax.jit(run, **donate_kw()))
         self._run_c = None
         if self.ingress == "compact":
             self._ensure_compact_fn()
@@ -362,9 +367,21 @@ class ResidentSummaryEngine(scan_analytics.StreamSummaryEngine):
         """Compact twin of the donated program: widen uint16 ids +
         rebuild the suffix mask ON DEVICE (the one shared decode,
         compact_ingress.widen_stack) fused into the same donated
-        scan."""
+        scan — or, when the Pallas megakernel is selected, fused one
+        level deeper (the compact body decodes per tile INSIDE the
+        kernel, ops/pallas_window), still under the same donation."""
         if self._run_c is None:
             eb_, vb_, body = self.eb, self.vb, self._body
+
+            if getattr(body, "pallas_window", False):
+                from . import pallas_window
+
+                run_pc = pallas_window.maybe_compact_scan_fn(
+                    eb_, vb_, self.kb, "resident_pallas_compact",
+                    jit_kwargs=donate_kw())
+                if run_pc is not None:
+                    self._run_c = run_pc
+                    return self._run_c
 
             def run_c(carry, s16, d16, nvalid):
                 s_w, d_w, valid_w = compact_ingress.widen_stack(

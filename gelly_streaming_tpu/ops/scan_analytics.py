@@ -49,13 +49,35 @@ from ..utils import telemetry
 from ..utils import wal as wal_mod
 
 
-def _build_scan(eb: int, vb: int, kb: int):
+def _build_scan(eb: int, vb: int, kb: int, pallas_ok: bool = True):
     """Scan body over fixed buckets. Cover layout: (+) side = v,
     (−) side = vb+1+v, so the shared sentinel slot vb (edge padding)
     maps to the two cover sentinels (vb, 2vb+1) and never touches real
-    slots."""
+    slots.
+
+    When the fused Pallas window megakernel is selected
+    (ops/pallas_window.resolve_pallas_window — GS_PALLAS_WINDOW pin or
+    committed parity+≥1.05× `pallas_ab` chip rows) AND its build/trace
+    probe succeeds, the returned body is the megakernel instead: one
+    VMEM-tiled pallas_call per window computing ALL analytics from a
+    single HBM read of the edge slab, same carry layout, same
+    per-window outputs, bit-identical by construction. `pallas_ok`
+    lets callers whose composition the kernel doesn't support yet opt
+    out — build_cohort_scan vmaps the body over a tenant axis, and
+    vmap-of-pallas_call stays unproven until its own chip row lands."""
+    if pallas_ok:
+        from . import pallas_window
+
+        pbody = pallas_window.maybe_window_body(eb, vb, kb)
+        if pbody is not None:
+            return pbody
     sent = vb
-    tri_body = tri_ops.build_window_counter(vb, kb)
+    # pallas_ok propagates INTO the embedded triangle counter: a
+    # pallas_ok=False caller (the vmapped cohort) must get a pure-XLA
+    # body all the way down — a pallas_call smuggled in through
+    # tri_body would be vmapped over the tenant axis anyway
+    tri_body = tri_ops.build_window_counter(vb, kb,
+                                            pallas_ok=pallas_ok)
 
     def body(carry, xs):
         deg, labels, cover = carry
@@ -96,8 +118,12 @@ def build_cohort_scan(eb: int, vb: int, kb: int):
     row (all-invalid windows) folds as a no-op against its carry, so
     per-tenant results are bit-identical to N separate
     StreamSummaryEngine runs — the parity contract tools/tenancy_ab.py
-    and tests/test_tenancy.py assert window by window."""
-    body = _build_scan(eb, vb, kb)
+    and tests/test_tenancy.py assert window by window. The cohort
+    body stays the XLA scan even when the Pallas megakernel is
+    selected for the single-stream engines (pallas_ok=False):
+    vmapping a pallas_call over the tenant axis is its own lowering
+    question, gated on its own future evidence."""
+    body = _build_scan(eb, vb, kb, pallas_ok=False)
 
     def one_tenant(carry, src_w, dst_w, valid_w):
         return jax.lax.scan(body, carry, (src_w, dst_w, valid_w))
@@ -160,6 +186,10 @@ class SummaryEngineBase:
             self._wal = None
             self._wal_dir = None
             self._wal_tenant = "engine"
+            # GS_WAL_RETAIN bookkeeping (utils/wal.RetentionCursor):
+            # remembers the last two flushed checkpoint offsets so
+            # truncation never outruns a rotation-fallback recovery
+            self._wal_retention = wal_mod.RetentionCursor()
         elif self._ckpt_policy is not None:
             # re-anchor the cadence with the rewound cursor: a stale
             # high-water mark would suppress every due() until the new
@@ -437,6 +467,13 @@ class SummaryEngineBase:
             # rest would be pure wasted compression + I/O.
             for snap in staged[-2:]:
                 checkpoint.save(self._ckpt_path, snap)
+                # journal retention at the flush boundary
+                # (GS_WAL_RETAIN): the floor is the snapshot's replay
+                # cursor — resume_offset() restarts at windows_done
+                # windows, so every record past that must survive
+                self._wal_retention.flushed(
+                    self._wal, self._wal_tenant,
+                    int(snap["windows_done"]) * self.eb)  # gslint: disable=host-sync (checkpoint payloads are host scalars, never device values)
         return out
 
     # -- shared pipeline pieces (static path + autotuned rounds) -------
@@ -714,8 +751,13 @@ class StreamSummaryEngine(SummaryEngineBase):
         # count against the O(log V) recompile envelope. The cost
         # observatory (utils/costmodel) rides the same wrapper: armed,
         # each signature's cost_analysis is captured and dispatches
-        # tag their ledger spans program="fused_scan"/sig.
-        self._run = metrics.wrap_jit("fused_scan", run)
+        # tag their ledger spans program="fused_scan"/sig — or
+        # program="pallas_window" when the megakernel body was
+        # selected, so the observatory attributes the new program
+        # separately from the scan-of-gathers it replaces.
+        self._pallas = bool(getattr(body, "pallas_window", False))
+        self._run = metrics.wrap_jit(
+            "pallas_window" if self._pallas else "fused_scan", run)
         self._body = body
         self._run_c = None  # compact twin, built on first use
         if self.ingress == "compact":
@@ -731,9 +773,24 @@ class StreamSummaryEngine(SummaryEngineBase):
         suffix mask from per-window counts) fused into the same scan
         program, applied to the whole [W, eb] stack before the scan
         consumes it. Built lazily so a standard-resolved engine whose
-        TUNER explores compact pays for it only when explored."""
+        TUNER explores compact pays for it only when explored.
+
+        When the Pallas megakernel is selected, the decode fuses one
+        level deeper: the compact body consumes the RAW uint16 stacks
+        and widens per tile INSIDE the kernel (the tentpole's
+        compact-ingress-decode stage) — no [W, eb] int32
+        intermediates ever materialize."""
         if self._run_c is None:
             eb_, vb_, body = self.eb, self.vb, self._body
+
+            if getattr(body, "pallas_window", False):
+                from . import pallas_window
+
+                run_pc = pallas_window.maybe_compact_scan_fn(
+                    eb_, vb_, self.kb, "pallas_window_compact")
+                if run_pc is not None:
+                    self._run_c = run_pc
+                    return self._run_c
 
             from . import compact_ingress as _ci
 

@@ -69,9 +69,9 @@ def triangle_count_dense(src: np.ndarray, dst: np.ndarray,
                          num_vertices: int) -> int:
     vb = seg_ops.bucket_size(num_vertices)
     eb = seg_ops.bucket_size(len(src))
-    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)
-    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)
-    rows = np.asarray(_dense_row_counts(jnp.asarray(s), jnp.asarray(d), vb))
+    s = seg_ops.pad_to(np.asarray(src, np.int32), eb, fill=vb)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+    d = seg_ops.pad_to(np.asarray(dst, np.int32), eb, fill=vb)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+    rows = np.asarray(_dense_row_counts(jnp.asarray(s), jnp.asarray(d), vb))  # gslint: disable=host-sync (sanctioned result boundary: the dense count's ONE d2h)
     return int(rows.astype(np.int64).sum() // 6)
 
 
@@ -286,8 +286,8 @@ def _intersect_jit():
 
 def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,
                           num_vertices: int) -> int:
-    src = np.asarray(src, np.int64)
-    dst = np.asarray(dst, np.int64)
+    src = np.asarray(src, np.int64)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+    dst = np.asarray(dst, np.int64)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
     keep = src != dst
     src, dst = src[keep], dst[keep]
     if len(src) == 0:
@@ -310,7 +310,7 @@ def triangle_count_sparse(src: np.ndarray, dst: np.ndarray,
     counts = np.bincount(a, minlength=num_vertices)
     starts = np.zeros(num_vertices + 1, np.int64)
     np.cumsum(counts, out=starts[1:])
-    max_out = seg_ops.bucket_size(int(counts.max()))
+    max_out = seg_ops.bucket_size(int(counts.max()))  # gslint: disable=host-sync (numpy-on-numpy: np.bincount result, no device value)
     # bucket the vertex dimension too, or every distinct per-window
     # vertex count triggers a fresh XLA compile; rows past num_vertices
     # (including sentinel row vb) stay all-sentinel
@@ -358,13 +358,16 @@ def dedupe_and_positions(a: jax.Array, b: jax.Array, sent: int, vb: int):
     scattered neighbor rows keep the sorted-row contract the binary-
     search intersect requires."""
     a, b = jax.lax.sort((a, b), num_keys=2)
-    first = jnp.concatenate([
-        jnp.array([True]),
-        (a[1:] != a[:-1]) | (b[1:] != b[:-1]),
-    ])
-    evalid = first & (a < sent)
     n = a.shape[0]
     idx = jnp.arange(n)
+    # first-occurrence mark without a materialized [1]-array head:
+    # position 0 is unconditionally first, the roll wraparound it
+    # masks is irrelevant. (A literal jnp.array([True]) head becomes
+    # a captured array constant, which a Pallas kernel body — the
+    # fused window megakernel inlines this helper — may not close
+    # over; identical booleans either way.)
+    first = (idx == 0) | (a != jnp.roll(a, 1)) | (b != jnp.roll(b, 1))
+    evalid = first & (a < sent)
     seg_first = jax.ops.segment_min(
         jnp.where(a < sent, idx, n), a, vb + 1)
     ev = evalid.astype(jnp.int32)
@@ -373,13 +376,21 @@ def dedupe_and_positions(a: jax.Array, b: jax.Array, sent: int, vb: int):
     return a, b, evalid, pos
 
 
-def build_window_counter(vb: int, kb: int):
+def build_window_counter(vb: int, kb: int, pallas_ok: bool = True):
     """Pure (unjitted) one-window exact-count body over fixed buckets:
     run(src[E], dst[E], valid[E]) -> (count, overflow); the edge bucket
     is whatever shape the caller traces with. Shared by
     TriangleWindowKernel (jitted / lax.map-wrapped) and the fused
     analytics scan (ops/scan_analytics.py), which inlines it in a scan
-    body."""
+    body.
+
+    When the fused window megakernel is selected
+    (ops/pallas_window.resolve_pallas_window) and its probe succeeds,
+    the returned body routes through the triangle-only Pallas kernel
+    — slab staged into VMEM once, K-bucket intersection via the
+    intersect seed's inner loop — falling back to this XLA body
+    in-trace for shapes past the chip's VMEM budget. Same counts,
+    same K-overflow handoff, by construction."""
     sent = vb  # sentinel vertex id: sorts last, row vb is the pad row
     intersect = resolve_intersect_impl()  # measured choice, build time
 
@@ -418,6 +429,12 @@ def build_window_counter(vb: int, kb: int):
                           b.astype(jnp.int32), evalid)
         return count, overflow
 
+    if pallas_ok:
+        from . import pallas_window
+
+        sel = pallas_window.maybe_counter(vb, kb, run)
+        if sel is not None:
+            return sel
     return run
 
 
@@ -608,7 +625,7 @@ def _tuned_kb(eb: int) -> int:
     # K tuning applies per BACKEND: the committed k-sweep for whatever
     # backend this process runs.
     _TUNED_KB[eb] = _fastest_sweep_row(
-        eb, "k_sweep", "k_bucket", default=min(128, 2 * int(np.sqrt(eb))))
+        eb, "k_sweep", "k_bucket", default=min(128, 2 * int(np.sqrt(eb))))  # gslint: disable=host-sync (python-int bucket math, no device value in sight)
     return _TUNED_KB[eb]
 
 
@@ -637,7 +654,7 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
         if measured:
             default = max(1, int(min(
                 measured,
-                key=lambda s: s["per_window_ms"])[value_key]))
+                key=lambda s: s["per_window_ms"])[value_key]))  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
     return default
 
 _TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch  # gslint: disable=thread-shared (idempotent memo of committed PERF.json evidence)
@@ -684,9 +701,9 @@ def compile_cap(program: str = "triangle_stream") -> int:
             if isinstance(sec, list):
                 rows += [r for r in sec
                          if r.get("program") == program]
-        clean = sorted(int(r["slots"]) for r in rows
+        clean = sorted(int(r["slots"]) for r in rows  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
                        if r.get("ok") is True and r.get("slots"))
-        failed = sorted(int(r["slots"]) for r in rows
+        failed = sorted(int(r["slots"]) for r in rows  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
                         if r.get("ok") is False and r.get("slots"))
         if clean:
             cap = max(cap, clean[-1])
@@ -834,7 +851,13 @@ class TriangleWindowKernel:
         self._stream_execs = {}
 
     def _build(self, kb):
-        return jax.jit(build_window_counter(self.vb, kb))
+        fn = build_window_counter(self.vb, kb)
+        # remember the selection for the AOT stream-program label: the
+        # cost observatory must attribute the megakernel-backed stream
+        # distinctly from the XLA one it replaces
+        self._pallas_counter = bool(getattr(fn, "pallas_window",
+                                            False))
+        return jax.jit(fn)
 
     def _escalation_ladder(self):
         """K values to try in order: kb, 4·kb, ... up to kb_max."""
@@ -919,8 +942,11 @@ class TriangleWindowKernel:
             # cost observatory (utils/costmodel): the AOT executable
             # carries its own cost_analysis — registration is free,
             # and armed dispatches tag their ledger spans program/sig
+            program = ("pallas_window_stream"
+                       if getattr(self, "_pallas_counter", False)
+                       else "triangle_stream")
             ex = costmodel.wrap_exec(
-                "triangle_stream", ex, metrics.abstract_sig(sds))
+                program, ex, metrics.abstract_sig(sds))
             self._stream_execs[key] = ex
         return ex
 
@@ -1251,10 +1277,10 @@ class TriangleWindowKernel:
             def one(win):
                 s, d = win
                 c = native_mod.triangle_count_stream(
-                    np.asarray(s), np.asarray(d), max(len(s), 1))
+                    np.asarray(s), np.asarray(d), max(len(s), 1))  # gslint: disable=host-sync (host-input normalization: window lists are numpy/python, never device values)
                 if c is None:
                     return None
-                return int(c[0]) if len(c) else 0
+                return int(c[0]) if len(c) else 0  # gslint: disable=host-sync (native-tier ctypes result: host numpy, no device value)
 
             # per-window ctypes calls across the prep pool (the C++
             # kernel drops the GIL); window order is preserved
@@ -1324,9 +1350,9 @@ def triangle_count(src: np.ndarray, dst: np.ndarray, num_vertices: int) -> int:
         from .. import native as native_mod
 
         counts = native_mod.triangle_count_stream(
-            np.asarray(src), np.asarray(dst), max(len(src), 1))
+            np.asarray(src), np.asarray(dst), max(len(src), 1))  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         if counts is not None:
-            return int(counts[0]) if len(counts) else 0
+            return int(counts[0]) if len(counts) else 0  # gslint: disable=host-sync (native-tier ctypes result: host numpy, no device value)
         tier = "host"
     if tier == "host":
         from . import host_triangles

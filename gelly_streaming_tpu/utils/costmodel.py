@@ -143,6 +143,12 @@ class _Registry:
         # (program, sig_key) -> {"count": n, "total_s": s} measured
         # dispatch spans (fed by the telemetry sink)
         self.dispatches: Dict[Tuple[str, str], dict] = {}
+        # program -> analytic cost template (record_analytic): for
+        # these programs the compiler's introspection is KNOWN wrong
+        # (a Pallas kernel's interpret lowering, an opaque Mosaic
+        # binary), so per-signature capture instantiates the stated
+        # model instead of compiling for analyses
+        self.analytic: Dict[str, dict] = {}
 
 
 _REG: Optional[_Registry] = None
@@ -245,6 +251,8 @@ def record_compiled(program: str, compiled, sig: tuple) -> None:
         # concurrent dispatcher never double-captures
         reg.programs[key] = {"program": program, "sig": key[1],
                              "pending": True}
+    if _instantiate_analytic(reg, key):
+        return
     entry = _extract(compiled)
     entry.update(program=program, sig=key[1])
     classify(entry)
@@ -254,6 +262,65 @@ def record_compiled(program: str, compiled, sig: tuple) -> None:
                     flops=entry.get("flops"),
                     bytes_accessed=entry.get("bytes_accessed"),
                     bound=entry.get("bound"))
+
+
+def record_analytic(program: str, sig_text: str, flops,
+                    bytes_accessed, **extra) -> None:
+    """Register a hand-computed cost entry for a program XLA's
+    introspection can't see through — the Pallas window megakernel:
+    its interpret-mode lowering bears no relation to the chip
+    kernel's HBM traffic, and a Mosaic executable exposes no
+    cost_analysis — under the same registry/joins as captured
+    entries. Rows carry model="analytic" (plus whatever `extra`
+    provenance the caller stamps, e.g. the slab-read byte model) so
+    a reader can tell a stated model from a compiler measurement.
+
+    Registers TWICE: the documentation row under the caller's
+    free-text `sig_text`, AND a program-level TEMPLATE that
+    on_call/record_compiled instantiate at each dispatch signature —
+    so the ledger spans wrap_jit tags (keyed by the abstract-shape
+    sig) join the STATED model, never a capture the registrant just
+    declared meaningless (and the armed extra-compile is skipped for
+    these programs). Idempotent per (program, sig); armed only."""
+    if not enabled():
+        return
+    key = (program, str(sig_text))
+    reg = _reg()
+    with reg.lock:
+        if key in reg.programs:
+            return
+        reg.programs[key] = {"program": program, "sig": key[1],
+                             "pending": True}
+    entry = {"program": program, "sig": key[1], "model": "analytic",
+             "flops": None if flops is None else int(flops),
+             "bytes_accessed": (None if bytes_accessed is None
+                                else int(bytes_accessed))}
+    entry.update(extra)
+    classify(entry)
+    with reg.lock:
+        reg.programs[key] = entry
+        reg.analytic[program] = {
+            k: v for k, v in entry.items() if k != "sig"}
+    telemetry.event("costmodel.capture", program=program, sig=key[1],
+                    flops=entry.get("flops"),
+                    bytes_accessed=entry.get("bytes_accessed"),
+                    bound=entry.get("bound"), model="analytic")
+
+
+def _instantiate_analytic(reg, key: Tuple[str, str]) -> bool:
+    """If `key[0]` has an analytic template, store its instance at
+    `key` (the dispatch signature the spans carry) and return True —
+    the capture paths then skip compiler introspection entirely."""
+    with reg.lock:
+        template = reg.analytic.get(key[0])
+        if template is None:
+            return False
+        if key not in reg.programs or \
+                reg.programs[key].get("pending"):
+            entry = dict(template)
+            entry["sig"] = key[1]
+            reg.programs[key] = entry
+    return True
 
 
 def on_call(program: str, fn, sig: tuple, args, kwargs) -> None:
@@ -273,6 +340,10 @@ def on_call(program: str, fn, sig: tuple, args, kwargs) -> None:
             return
         reg.programs[key] = {"program": program, "sig": key[1],
                              "pending": True}
+    if _instantiate_analytic(reg, key):
+        # a stated-model program: no extra AOT compile, the spans
+        # join the analytic entry at this very signature
+        return
     lower = getattr(fn, "lower", None)
     if lower is None:
         entry = {"program": program, "sig": key[1],

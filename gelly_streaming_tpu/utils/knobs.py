@@ -261,6 +261,28 @@ register("GS_RESIDENT_SLOTS", "int", 2, lo=1,
               "prepped+transferred ahead of dispatch (2 = the "
               "double-buffered form — slot N+1 fills while N computes)")
 
+# fused window megakernel (ops/pallas_window.py)
+register("GS_PALLAS_WINDOW", "str", "", choices=("on", "off", "auto"),
+         help="pin the fused Pallas window megakernel "
+              "(`ops/pallas_window.py`): `on` forces it (interpret "
+              "mode off-TPU), `off` never selects it; unset/`auto` = "
+              "adopt only on committed parity+≥1.05× `pallas_ab` "
+              "rows — the XLA fused scan stands until a chip row "
+              "lands",
+         default_text="auto")
+register("GS_PALLAS_TILE", "int", 0, lo=0,
+         help="pin the megakernel's edge-tile size (edges per grid "
+              "step, power of two ≤ edge_bucket); 0 (default) = the "
+              "`pallas_window` tuner's persisted optimum, else the "
+              "whole slab off-TPU (interpret unrolls the grid at "
+              "trace) / 512 on chip",
+         default_text="0 (auto)")
+register("GS_PALLAS_CK", "int", 0, lo=0,
+         help="pin the megakernel's intersection compare-chunk width "
+              "(the K-chunk of the seed kernel's inner loop); 0 "
+              "(default) = min(128, k_bucket)",
+         default_text="0 (auto)")
+
 # egress (ops/delta_egress.py)
 register("GS_EGRESS", "str", "", choices=("full", "delta", "auto"),
          help="pin the batched d2h egress: `full` (whole snapshot "
@@ -369,6 +391,15 @@ register("GS_WAL_FSYNC_S", "float", 0.0, lo=0.0,
               "window), >0 batches fsyncs to at most one per interval "
               "(appends in between are flushed but not synced)",
          default_text="0 (every append)")
+register("GS_WAL_RETAIN", "bool", False,
+         help="`1` arms journal retention: every checkpoint FLUSH "
+              "(engine/driver auto-checkpoint, cohort "
+              "`checkpoint_all()`) calls `truncate_covered()` with "
+              "the OLDER of the two kept checkpoint generations' "
+              "offsets, so bounded disk never deletes a record a "
+              "rotation-fallback recovery would still replay; 0 "
+              "(default) keeps every closed segment",
+         default_text="0 (off)")
 register("GS_WAL_SEGMENT_BYTES", "int", 1 << 26, lo=4096,
          help="journal segment-rotation size: a segment past this "
               "many bytes closes (fsync'd) and appends continue in a "

@@ -78,6 +78,53 @@ def enabled() -> bool:
     return knobs.get_bool("GS_WAL")
 
 
+class RetentionCursor:
+    """Checkpoint-flush-driven journal retention (GS_WAL_RETAIN).
+
+    Each flush site (engine/driver auto-checkpoint,
+    TenantCohort.checkpoint_all) reports the per-tenant replay offset
+    its just-SAVED checkpoint covers; the cursor remembers the last
+    TWO reported offsets per tenant and truncates the journal at the
+    OLDER one. Two, because utils/checkpoint.save keeps two
+    generations (current + `.prev`) and load_latest falls back one on
+    corruption — a recovery landing on `.prev` must still find its
+    whole replay suffix, so the deletable prefix is only what even
+    the previous generation no longer needs. A tenant's FIRST flush
+    truncates nothing (floor 0): with only one generation on disk
+    there is no `.prev` to fall back to, and a damaged sole
+    checkpoint means recovery starts fresh and replays from offset
+    0 — which must still be possible. Disarmed (the GS_WAL_RETAIN
+    default) every call is a no-op, live per call so tests and
+    operators can flip it mid-process."""
+
+    def __init__(self):
+        self._hist: Dict[str, List[int]] = {}
+
+    def flushed_many(self, wal: Optional["WriteAheadLog"],
+                     offsets: Dict[str, int]) -> int:
+        """Record one flush boundary covering `offsets` (per-tenant
+        cumulative edges) and truncate; returns segments removed. The
+        offsets map must name EVERY tenant the flush covers — the
+        cohort passes all tenants at once, because truncate_covered
+        treats an unnamed tenant's records as offset 0 (uncovered)."""
+        if wal is None or not knobs.get_bool("GS_WAL_RETAIN"):
+            return 0
+        floors: Dict[str, int] = {}
+        for tid, off in offsets.items():
+            h = self._hist.setdefault(str(tid), [])
+            h.append(int(off))
+            del h[:-2]
+            # older of the last TWO flushes; a single-entry history
+            # floors at 0 — see the class docstring
+            floors[str(tid)] = h[0] if len(h) == 2 else 0
+        return wal.truncate_covered(floors)
+
+    def flushed(self, wal: Optional["WriteAheadLog"], tenant: str,
+                offset: int) -> int:
+        """Single-tenant form (engine/driver journals)."""
+        return self.flushed_many(wal, {str(tenant): int(offset)})
+
+
 def fsync_interval_s() -> float:
     """GS_WAL_FSYNC_S: 0 (default) fsyncs every append; >0 batches
     fsyncs to at most one per interval."""

@@ -28,6 +28,18 @@ def traced(xs):
     return lax.scan(_step, 0, xs)
 
 
+def _kernel(in_ref, out_ref):
+    # TP: a knob read inside a Pallas kernel body freezes into the
+    # compiled Mosaic program exactly like any jit-traced read
+    out_ref[0] = in_ref[0] + knobs.get_bool("GS_AUTOTUNE")
+
+
+def pallas_entry(x):
+    from jax.experimental import pallas as pl
+
+    return pl.pallas_call(_kernel, out_shape=x)(x)
+
+
 def host_only():
     # TN: same reads, never traced
     _MEMO["x"] = os.environ.get("GS_TELEMETRY")

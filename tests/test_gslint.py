@@ -94,7 +94,7 @@ def test_baseline_policy(gslint):
     assert baseline, "committed baseline missing"
     assert all(key[0] == "R1" for key in baseline), (
         "baseline may only grandfather R1 host-sync sites")
-    assert len(baseline) <= 82
+    assert len(baseline) <= 65
     # every entry still corresponds to a live finding: stale entries
     # (the flagged line was fixed or deleted) must be pruned so the
     # baseline can't silently absorb a future regression at that key
@@ -156,7 +156,9 @@ def test_r1_sanctioned_modules_exempt(gslint):
 def test_r2_true_positives(fixture_findings):
     hits = _hits(fixture_findings, "R2",
                  "gelly_streaming_tpu/fix_r2.py")
-    assert {f.symbol for f in hits} == {"_step"}
+    # _kernel: a Pallas kernel body is a traced root too (the fused
+    # window megakernel made pallas_call part of the traced surface)
+    assert {f.symbol for f in hits} == {"_step", "_kernel"}
     msgs = " ".join(f.message for f in hits)
     assert "os.environ" in msgs
     assert "time.perf_counter" in msgs

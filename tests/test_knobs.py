@@ -20,6 +20,7 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_MESH_WIRE_CHECK GS_AUTOTUNE GS_AUTOTUNE_ROUND "
        "GS_AUTOTUNE_EXPLORE GS_TUNE_CACHE "
        "GS_RESIDENT GS_RESIDENT_SPB GS_RESIDENT_SLOTS "
+       "GS_PALLAS_WINDOW GS_PALLAS_TILE GS_PALLAS_CK "
        "GS_EGRESS GS_EGRESS_CAP "
        "GS_TELEMETRY GS_TRACE_DIR GS_TRACE_RING "
        "GS_TRACE_DURABLE GS_METRICS GS_METRICS_PORT "
@@ -27,7 +28,7 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_HEALTH_STALE_S "
        "GS_TENANT_MAX GS_TENANT_QUEUE_WINDOWS GS_TENANT_ADMISSION "
        "GS_TENANT_TPD "
-       "GS_WAL GS_WAL_FSYNC_S GS_WAL_SEGMENT_BYTES "
+       "GS_WAL GS_WAL_RETAIN GS_WAL_FSYNC_S GS_WAL_SEGMENT_BYTES "
        "GS_SERVE_PORT GS_SERVE_DRAIN_S GS_SERVE_IDLE_S "
        "GS_COSTMODEL GS_COSTMODEL_PEAK_GFLOPS "
        "GS_COSTMODEL_PEAK_GBPS").split()

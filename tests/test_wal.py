@@ -406,3 +406,160 @@ def test_stream_file_refused_on_journal_armed_driver(tmp_path):
     assert drv.enable_wal(str(tmp_path / "wal"))
     with pytest.raises(ValueError, match="journal-armed"):
         list(drv.stream_file(p))
+
+
+# ----------------------------------------------------------------------
+# GS_WAL_RETAIN: truncation at checkpoint-flush boundaries
+# ----------------------------------------------------------------------
+def _retain_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("GS_WAL_RETAIN", "1")
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    return str(tmp_path / "wal")
+
+
+def _segments(d):
+    return sorted(p for p in os.listdir(d) if p.endswith(".seg"))
+
+
+def test_engine_auto_checkpoint_truncates_and_replays_exactly(
+        tmp_path, monkeypatch):
+    """The engine's auto-checkpoint flush truncates covered journal
+    segments (GS_WAL_RETAIN), and a recovery AFTER truncation —
+    including one that falls back a checkpoint generation — still
+    replays bit-exactly from the new floor."""
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    wal_dir = _retain_env(monkeypatch, tmp_path)
+    ck = str(tmp_path / "ck.npz")
+    rng = np.random.default_rng(11)
+    src = rng.integers(0, 100, 4096).astype(np.int32)
+    dst = rng.integers(0, 100, 4096).astype(np.int32)
+
+    eng = StreamSummaryEngine(edge_bucket=128, vertex_bucket=128)
+    assert eng.enable_wal(wal_dir)
+    eng.enable_auto_checkpoint(ck, every_n_windows=4)
+    oracle = []
+    for i in range(0, 4096, 1024):
+        oracle += eng.process(src[i:i + 1024], dst[i:i + 1024])
+    segs = _segments(wal_dir)
+    assert segs and int(segs[0][4:12]) > 0, \
+        "no covered segment was truncated"
+    # the floor lags ONE generation: the .prev checkpoint's replay
+    # suffix must still be fully present
+    from gelly_streaming_tpu.utils import checkpoint
+
+    prev_state = checkpoint.restore(ck + ".prev")
+    prev_cursor = int(prev_state["windows_done"]) * 128
+    replayable = sorted(start for _t, start, *_ in
+                        wal.replay(wal_dir, {"engine": 0}))
+    assert replayable and replayable[0] <= prev_cursor
+
+    # kill here → fresh engine recovers and continues exactly
+    eng2 = StreamSummaryEngine(edge_bucket=128, vertex_bucket=128)
+    eng2.enable_wal(wal_dir)
+    replayed = eng2.resume_and_replay(ck)
+    done = eng2.windows_done
+    assert replayed == oracle[done - len(replayed):done]
+    more_s = rng.integers(0, 100, 1024).astype(np.int32)
+    more_d = rng.integers(0, 100, 1024).astype(np.int32)
+    cont = eng2.process(more_s, more_d)
+    oracle_full = StreamSummaryEngine(
+        edge_bucket=128, vertex_bucket=128).process(
+        np.concatenate([src, more_s]), np.concatenate([dst, more_d]))
+    assert oracle == oracle_full[:len(oracle)]
+    assert cont == oracle_full[done:]
+
+
+def test_retain_disarmed_keeps_every_segment(tmp_path, monkeypatch):
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    monkeypatch.delenv("GS_WAL_RETAIN", raising=False)
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    wal_dir = str(tmp_path / "wal")
+    ck = str(tmp_path / "ck.npz")
+    rng = np.random.default_rng(12)
+    src = rng.integers(0, 100, 4096).astype(np.int32)
+    dst = rng.integers(0, 100, 4096).astype(np.int32)
+    eng = StreamSummaryEngine(edge_bucket=128, vertex_bucket=128)
+    assert eng.enable_wal(wal_dir)
+    eng.enable_auto_checkpoint(ck, every_n_windows=4)
+    eng.process(src, dst)
+    assert int(_segments(wal_dir)[0][4:12]) == 0  # nothing deleted
+
+
+def test_cohort_checkpoint_all_truncates_shared_journal(
+        tmp_path, monkeypatch):
+    """checkpoint_all() moves EVERY tenant's floor in one truncation
+    (a shared segment is only deletable once all its tenants are
+    covered), and a post-truncate recover() reproduces the fault-free
+    continuation exactly."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    wal_dir = _retain_env(monkeypatch, tmp_path)
+    rng = np.random.default_rng(13)
+
+    def feed_all(co, n):
+        for t in ("a", "b"):
+            co.feed(t, rng.integers(0, 90, n).astype(np.int32),
+                    rng.integers(0, 90, n).astype(np.int32))
+
+    co = TenantCohort(edge_bucket=128, vertex_bucket=128)
+    co.enable_auto_checkpoint(str(tmp_path / "ck"))
+    assert co.enable_wal(wal_dir)
+    for t in ("a", "b"):
+        co.admit(t)
+    outs = {"a": [], "b": []}
+    rng_oracle = np.random.default_rng(13)
+    fed = {"a": [], "b": []}
+    for _ in range(4):
+        for t in ("a", "b"):
+            s = rng_oracle.integers(0, 90, 1024).astype(np.int32)
+            d = rng_oracle.integers(0, 90, 1024).astype(np.int32)
+            co.feed(t, s, d)
+            fed[t].append((s, d))
+        for t, res in co.pump().items():
+            outs[t] += res
+        # two flush boundaries move the two-generation floor forward
+        assert co.checkpoint_all() == 2
+    segs = _segments(wal_dir)
+    assert segs and int(segs[0][4:12]) > 0, \
+        "no covered shared segment was truncated"
+
+    # kill → fresh cohort recovers off the truncated journal
+    co2 = TenantCohort(edge_bucket=128, vertex_bucket=128)
+    co2.enable_auto_checkpoint(str(tmp_path / "ck"))
+    assert co2.enable_wal(wal_dir)
+    co2.recover()
+    outs2 = {"a": list(outs["a"]), "b": list(outs["b"])}
+    for t, res in co2.pump().items():
+        outs2[t] += res
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    for t in ("a", "b"):
+        oracle = StreamSummaryEngine(
+            edge_bucket=128, vertex_bucket=128).process(
+            np.concatenate([s for s, _ in fed[t]]),
+            np.concatenate([d for _, d in fed[t]]))
+        assert outs2[t][:len(oracle)] == oracle
+
+
+def test_retain_first_flush_truncates_nothing(tmp_path, monkeypatch):
+    """Review fix: a tenant's FIRST checkpoint flush must not
+    truncate — only one generation exists, so a damaged sole
+    checkpoint still needs the whole journal to replay from 0."""
+    monkeypatch.setenv("GS_WAL_RETAIN", "1")
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    w = _mk(tmp_path)
+    cur = wal.RetentionCursor()
+    for i in range(40):  # force several closed segments
+        s, d = _edges(64, i)
+        w.append("t1", s, d)
+    assert len(_segments(w.dir)) > 1
+    before = _segments(w.dir)
+    assert cur.flushed(w, "t1", 64 * 40) == 0
+    assert _segments(w.dir) == before
+    # the SECOND flush floors at the first's offset and truncates
+    assert cur.flushed(w, "t1", 64 * 40) > 0

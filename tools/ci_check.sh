@@ -20,6 +20,10 @@
 #      digest-identical to the direct cohort feed, with a sealed
 #      journal — the wire/durability layer changes availability,
 #      never results
+#   7. pallas megakernel smoke (tools/pallas_smoke.py): one window
+#      through the interpret-mode fused window megakernel must be
+#      digest-identical to the XLA fused scan, so Pallas API drift
+#      is caught without a chip
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -28,27 +32,30 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/6] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/7] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/6] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/7] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/6] gslint =="
+echo "== [2/7] gslint =="
 python -m tools.gslint
 
-echo "== [3/6] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/7] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/6] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/7] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/6] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/7] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
-echo "== [6/6] serve parity smoke (loopback + drain ≡ direct feed) =="
+echo "== [6/7] serve parity smoke (loopback + drain ≡ direct feed) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
+
+echo "== [7/7] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
+JAX_PLATFORMS=cpu python tools/pallas_smoke.py
 
 echo "ci_check: all gates green"

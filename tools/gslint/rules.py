@@ -143,6 +143,12 @@ class JitPurityRule(Rule):
         "lax.cond": (1, 2), "jax.lax.cond": (1, 2),
         "shard_map": (0,), "shard_map_norep": (0,),
         "jax.experimental.shard_map.shard_map": (0,),
+        # Pallas kernel bodies trace like any jit root (and freeze
+        # even harder: the kernel compiles once per shape into a
+        # Mosaic binary) — ops/pallas_window.py and the seed kernels
+        # are in-scope via their pallas_call sites
+        "pl.pallas_call": (0,), "pallas_call": (0,),
+        "pallas.pallas_call": (0,),
     }
     _CLOCK_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
                     "time.sleep", "time.process_time"}

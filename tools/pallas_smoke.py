@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""CI gate [7/7]: interpret-mode megakernel smoke.
+
+One window through StreamSummaryEngine with the fused Pallas window
+megakernel pinned ON (interpret mode on the CPU backend) must be
+digest-identical to the XLA fused scan — so the static gate catches
+Pallas API drift (a jax upgrade changing pallas_call's contract, a
+broken kernel edit) without a chip, the same way gate 5 pins the
+cohort to the single-stream digest. Exits non-zero on digest
+mismatch OR if the megakernel was not actually selected (a silently
+refused probe would otherwise let the gate pass while testing
+nothing).
+
+Usage: JAX_PLATFORMS=cpu python tools/pallas_smoke.py
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _digest(summaries) -> str:
+    h = hashlib.sha256()
+    for s in summaries:
+        h.update(json.dumps(s, sort_keys=True).encode())
+    return h.hexdigest()[:16]
+
+
+def main() -> int:
+    os.environ.setdefault("GS_AUTOTUNE", "0")
+    from gelly_streaming_tpu.ops import pallas_window as pw
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+
+    eb = vb = 256
+    rng = np.random.default_rng(42)
+    src = rng.integers(0, vb - 8, eb).astype(np.int32)
+    dst = rng.integers(0, vb - 8, eb).astype(np.int32)
+
+    os.environ["GS_PALLAS_WINDOW"] = "off"
+    pw._reset_pallas_window()
+    ref = StreamSummaryEngine(edge_bucket=eb,
+                              vertex_bucket=vb).process(src, dst)
+
+    os.environ["GS_PALLAS_WINDOW"] = "on"
+    pw._reset_pallas_window()
+    eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+    if not eng._pallas:
+        print("pallas_smoke: megakernel NOT selected under "
+              "GS_PALLAS_WINDOW=on (build/trace probe refused — see "
+              "the durable selection.fallback event)")
+        return 1
+    got = eng.process(src, dst)
+
+    dr, dg = _digest(ref), _digest(got)
+    if dr != dg:
+        print("pallas_smoke: DIGEST MISMATCH megakernel %s != xla %s"
+              % (dg, dr))
+        print("xla: %s" % json.dumps(ref))
+        print("pallas: %s" % json.dumps(got))
+        return 1
+    print("pallas_smoke: ok (1 window, digest %s, megakernel ≡ XLA "
+          "fused scan)" % dr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

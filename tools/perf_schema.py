@@ -37,6 +37,10 @@ LIST_SECTIONS = {
     "ingress_ab": ("probe", "parity"),
     "egress_ab": ("probe", "parity"),
     "resident_ab": ("probe", "parity"),
+    # fused Pallas window megakernel A/B (tools/pallas_ab.py):
+    # megakernel vs XLA scan-of-gathers, sha256 window parity vs the
+    # host twins; resolve_pallas_window gates on these rows
+    "pallas_ab": ("probe", "parity"),
     # multi-tenant cohort A/B (tools/tenancy_ab.py): N-tenant vmapped
     # dispatch vs N sequential single-tenant engines, per-tenant
     # sha256 parity
@@ -88,7 +92,7 @@ _COST_PROGRAM_KEYS = ("program", "sig", "flops", "bytes_accessed",
 # A/B sections whose parity-true rows must claim a positive speedup
 # (the adoption gates divide by it; rows_clear_bar rejects otherwise)
 _AB_SECTIONS = ("ingress_ab", "egress_ab", "resident_ab",
-                "tenancy_ab")
+                "tenancy_ab", "pallas_ab")
 
 
 def _check_rows(name: str, rows, errors) -> None:

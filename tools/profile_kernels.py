@@ -1057,6 +1057,28 @@ def section_resident_ab(results: dict) -> None:
     results["resident_ab"] = rows
 
 
+def section_pallas_ab(results: dict) -> None:
+    """Fused-window-megakernel A/B (ops/pallas_window.py) — the
+    committed evidence `resolve_pallas_window` reads, via the same
+    probes as the standalone tools/pallas_ab.py: Pallas megakernel vs
+    XLA scan-of-gathers through the summary engine AND the triangle
+    stream kernel, sha256 window parity against the host twins,
+    median-of-3 with dispersion. GS_AUTOTUNE is already pinned off
+    for this child, so the kernel lever is measured in isolation. On
+    a CPU backend the kernel runs interpreted: the parity half of the
+    row is real evidence, the speed half is not (and the
+    backend-matched loader keeps it from driving a chip selection)."""
+    import jax
+
+    from tools.pallas_ab import engine_pallas, stream_pallas
+
+    rows = []
+    edges = int(os.environ.get("GS_AB_EDGES", 524_288))
+    engine_pallas(jax, edges, rows)
+    stream_pallas(jax, edges, rows)
+    results["pallas_ab"] = rows
+
+
 def section_autotune(results: dict) -> None:
     """Online dispatch-tuner evidence (ops/autotune.py): the triangle
     stream's device path static vs tuned-from-cold vs tuned-seeded
@@ -1619,6 +1641,10 @@ SECTIONS = {
     # super-batch form): wedge-prone on the tunneled chip, so it runs
     # with the other scan-class compiles at the END of the order
     "resident_ab": section_resident_ab,
+    # pallas_ab compiles the megakernel-bodied scan programs (Mosaic
+    # kernels inside a scan): scan-class compiles, END of the order
+    # beside resident_ab
+    "pallas_ab": section_pallas_ab,
     # cost_model AOT-compiles the fused-scan/resident programs once
     # more for their analyses: scan-class compiles, END of the order
     "cost_model": section_cost_model,
