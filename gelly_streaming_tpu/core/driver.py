@@ -45,6 +45,7 @@ from ..ops import triangles as tri_ops
 from ..ops import unionfind
 from ..utils import checkpoint
 from ..utils import faults
+from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
@@ -292,6 +293,11 @@ class WindowResult:
     delta_degrees: Optional[tuple] = None       # (int32 ids, int64 vals)
     delta_cc: Optional[tuple] = None            # (int32 ids, int32 vals)
     delta_bipartite: Optional[tuple] = None     # (int32 ids, bool vals)
+    # latency plane (utils/latency.py, GS_LATENCY=1): this window's
+    # ingest→deliver record — {"e2e_s", "stages": {...}, "replayed"} —
+    # joined back to the admission stamp of its completing edge.
+    # Always None disarmed (the digest-parity contract).
+    latency: Optional[dict] = None
 
 
 class StreamingAnalyticsDriver:
@@ -628,6 +634,12 @@ class StreamingAnalyticsDriver:
         ingestion-time analog at a fixed batch rate). `_starts` lets
         stream_file pass its already-computed window assignment."""
         metrics.on_stream_start("driver", tenant=self.tenant)
+        # latency plane: every batch is stamped at THIS admission
+        # boundary (the driver's live-feed entry). The WAL ts column
+        # stays reserved for EVENT time on this path (replay feeds it
+        # back through event-time windowing), so driver replays
+        # re-stamp at the replay moment rather than overload it.
+        lat_t0 = latency.clock() if latency.enabled() else None
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
 
@@ -670,6 +682,9 @@ class StreamingAnalyticsDriver:
             _journal(np.asarray(ts, np.int64)
                      if ts is not None and len(np.atleast_1d(ts))
                      else None)
+            if lat_t0 is not None:
+                latency.on_admit(self.tenant or "driver", len(src),
+                                 t0=lat_t0)
             return self._dispatch_windows(windows)
         # count-based: window_start = absolute stream offset; the
         # edges_done cursor advances per window (inside _window, so
@@ -684,6 +699,9 @@ class StreamingAnalyticsDriver:
                 "(length not a multiple of edge_bucket); chunked "
                 "count-based feeding must use edge_bucket multiples")
         _journal()
+        if lat_t0 is not None:
+            latency.on_admit(self.tenant or "driver", len(src),
+                             t0=lat_t0)
         windows = []
         at = self.edges_done
         for i in range(0, len(src), self.eb):
@@ -1234,10 +1252,35 @@ class StreamingAnalyticsDriver:
         # order; an exception mid-call still leaves the driver at the
         # last FINALIZED chunk (resumable). The host/native tier stays
         # synchronous — one core, nothing to overlap with.
+        disp_t = [None]  # dispatch-boundary stamp of the pending chunk
+
         def _boundary(at, chunk):
             # chunk boundary: cursors, the partial flag, and the
             # checkpoint move together (mirrors moved just before)
             edges = sum(len(s) for _w, s, _d, _n in chunk)
+            if latency.enabled():
+                # per-window ingest→deliver records (the driver's
+                # coarse decomposition: its dispatch boundary folds
+                # prep+h2d+device wait; synchronous tiers have no
+                # dispatch stamp and report admission+finalize only).
+                # Results were appended by this chunk's finalize, so
+                # each record attaches to its WindowResult.
+                st = ({"dispatch": disp_t[0]}
+                      if disp_t[0] is not None else None)
+                disp_t[0] = None
+                lane = self.tenant or "driver"
+                for i, (_w, s, _d, _n) in enumerate(chunk):
+                    rec = latency.on_window(
+                        lane, edges=len(s), st=st,
+                        ordinal=self.windows_done + i)
+                    if rec is not None \
+                            and len(results) >= len(chunk):
+                        res = results[len(results) - len(chunk) + i]
+                        res.latency = {
+                            "e2e_s": rec["e2e_s"],
+                            "stages": dict(rec["stages"]),
+                            "replayed": rec["replayed"],
+                        }
             self.windows_done += len(chunk)
             self.edges_done += edges
             metrics.mark_window(len(chunk), edges, engine="driver",
@@ -1373,6 +1416,10 @@ class StreamingAnalyticsDriver:
 
                 f_outs = resilience.call_guarded(
                     "finalize", f_at, _mat, retries=0)
+            # the dispatch boundary of this chunk's waterfall closes
+            # with the materialize (device execute + d2h, observed)
+            disp_t[0] = (latency.clock() if latency.enabled()
+                         else None)
             _finalize_chunk(f_at, f_chunk, f_outs)
             if f_sw is not None:
                 # the resident super-batch span closes at its DRAIN —
@@ -2029,6 +2076,14 @@ class StreamingAnalyticsDriver:
                     self._run_one_laddered(name, s, d, nv, res)
         if prev is not None:
             self._attach_host_deltas(res, prev)
+        if latency.enabled():
+            rec = latency.on_window(self.tenant or "driver",
+                                    edges=len(src),
+                                    ordinal=self.windows_done)
+            if rec is not None:
+                res.latency = {"e2e_s": rec["e2e_s"],
+                               "stages": dict(rec["stages"]),
+                               "replayed": rec["replayed"]}
         self.windows_done += 1
         self.edges_done += len(src)
         metrics.mark_window(

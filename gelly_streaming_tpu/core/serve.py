@@ -40,7 +40,12 @@ with the pieces a deployable stream service needs and the ROADMAP's
   tests/test_checkpoint_roundtrip.py).
 - **Observability.** `gs_serve_*` counters/gauges, a `serve` section
   on `/healthz` (metrics.register_health_section), and durable ledger
-  events for drain/seal/replay/shed.
+  events for drain/seal/replay/shed. With the latency plane armed
+  (GS_LATENCY=1, utils/latency.py): every delivered results row
+  carries `latency_s` (ingest→deliver, the sink write stamped as the
+  `deliver` stage) + `queue_edges`, and the `status`/`serve` section
+  adds per-tenant queue depth+age and the latency percentiles — the
+  client self-throttle loop.
 
 Run one standalone:
 
@@ -67,6 +72,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..utils import knobs
+from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
@@ -107,6 +113,10 @@ class StreamServer:
                  max_connections: int = 32,
                  results_path: Optional[str] = None):
         self.cohort = cohort
+        # latency plane: the cohort defers each finalized window's
+        # record so _emit can stamp the DELIVERY boundary (the sink
+        # write) — ingest→deliver, not ingest→finalize
+        cohort.defer_delivery = True
         self._lock = threading.RLock()
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
@@ -364,6 +374,18 @@ class StreamServer:
             base = self.cohort.windows_done(tid) - len(summaries)
             rows = [{"tenant": tid, "window": base + i, "summary": s}
                     for i, s in enumerate(summaries)]
+            # DELIVERY boundary of the latency plane: close each
+            # window's deferred record here so its waterfall includes
+            # the pump→sink gap, and let clients self-throttle off
+            # the row itself (latency_s + current queue depth). Keys
+            # appear only armed — disarmed rows are bit-identical.
+            if latency.enabled():
+                queued = self.cohort.queued_edges(tid)
+                for row in rows:
+                    rec = latency.delivered(tid, row["window"])
+                    if rec is not None:
+                        row["latency_s"] = round(rec["e2e_s"], 6)
+                        row["queue_edges"] = int(queued)
             out[tid] = rows
             self.results.setdefault(tid, []).extend(rows)
             self._stats["windows"] += len(rows)
@@ -476,6 +498,16 @@ class StreamServer:
             with self._lock:
                 self.cohort.checkpoint_all()
                 self.cohort.seal_wal()
+                # hand the cohort back to the direct-pump shape: a
+                # cohort outliving its server must emit latency
+                # records at finalize again, and nothing still
+                # pending will ever see a delivered() call. Settle is
+                # LANE-SCOPED to this cohort's tenants — another
+                # server's pending records are not ours to flush.
+                self.cohort.defer_delivery = False
+                lanes = list(self.cohort.tenants)
+            for tid in lanes:
+                latency.settle(tid)
             if self._results_file is not None:
                 self._results_file.flush()
                 os.fsync(self._results_file.fileno())
@@ -539,6 +571,12 @@ class StreamServer:
                 self._results_file.close()
             except OSError:
                 pass
+        # see drain(): a cohort outliving this server emits at
+        # finalize again, and still-pending records of ITS lanes
+        # settle now (lane-scoped — never another server's)
+        self.cohort.defer_delivery = False
+        for tid in list(self.cohort.tenants):
+            latency.settle(tid)
         metrics.unregister_health_section("serve")
 
     # ------------------------------------------------------------------
@@ -549,11 +587,22 @@ class StreamServer:
             stats = dict(self._stats)
             active = len(self._conns)
             wal = self.cohort._wal
+            # per-tenant queue DEPTH and AGE (the self-throttle
+            # signal: depth says how much is queued, age says how
+            # stale the oldest queued edge already is)
+            queues = {
+                tid: {"edges": int(t.queued),
+                      "age_s": (None if t.queued == 0
+                                else latency.queue_age(tid))}
+                for tid, t in self.cohort.tenants.items()
+                if not t.closed}
         sec = {
             "port": self.port,
             "draining": self._draining.is_set(),
             "active_connections": active,
             "tails": len(self._tails),
+            "queues": queues,
+            "latency": latency.health_section(),
             **stats,
         }
         if wal is not None:

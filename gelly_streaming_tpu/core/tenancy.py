@@ -80,6 +80,7 @@ from ..ops import triangles as tri_ops
 from ..utils import checkpoint
 from ..utils import faults
 from ..utils import knobs
+from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
 from ..utils import telemetry
@@ -233,6 +234,11 @@ class TenantCohort:
         self._round_no = 0
         self._wal = None           # utils/wal.WriteAheadLog when armed
         self._wal_dir = None
+        # latency plane (utils/latency.py): the serving front-end
+        # flips this so finalized windows defer their latency record
+        # to the results-sink write (serve._emit stamps `deliver`);
+        # direct pump() callers emit at finalize (deliver = 0)
+        self.defer_delivery = False
         # GS_WAL_RETAIN bookkeeping: journal truncation at the
         # checkpoint_all() flush boundary, floored per tenant at the
         # older kept generation (utils/wal.RetentionCursor)
@@ -300,7 +306,15 @@ class TenantCohort:
         TenantBackpressure accepting NOTHING (the caller owns retry —
         an atomic refusal can't split a window across a retry
         boundary), `drop` accepts what fits and sheds the rest with a
-        durable event + counter."""
+        durable event + counter.
+
+        With the latency plane armed (GS_LATENCY=1), the accepted
+        batch is stamped with a monotonic admission timestamp at THIS
+        boundary — carried through the journal's ts column so
+        replayed edges keep their original admission time — and the
+        per-tenant queue-age gauge updates."""
+        lat = latency.enabled()
+        t_admit = latency.clock() if lat else 0.0
         t = self._tenant(tenant_id, for_feed=True)
         if t.closed_partial:
             # the engines' partial-window-must-be-final guard: a
@@ -352,10 +366,18 @@ class TenantCohort:
                 # recoverable by replay; a rejected feed() journals
                 # nothing, keeping replay and the caller's view of
                 # what was accepted identical
-                self._wal.append(t.tid, src[:take], dst[:take])
+                self._wal.append(
+                    t.tid, src[:take], dst[:take],
+                    # admission stamp riding the ts column (int64 ns,
+                    # monotonic domain): recovery re-seeds the latency
+                    # marks with the ORIGINAL admission time
+                    np.full(take, latency.admit_ns(t_admit), np.int64)
+                    if lat else None)
                 faults.fire("wal_enqueue", t.tid)
             t.src = np.concatenate([t.src, src[:take]])
             t.dst = np.concatenate([t.dst, dst[:take]])
+            if lat:
+                latency.on_admit(t.tid, take, t0=t_admit)
         metrics.gauge_set("gs_tenant_queue_edges", t.queued,
                           tenant=t.tid)
         return take
@@ -452,6 +474,8 @@ class TenantCohort:
         slab [nb, wb, eb] (+ per-tenant failures for demotion). Runs
         on the ingress worker pool via the ingest ring when available;
         reads queues only — consumption happens at finalize."""
+        st = latency.stamps()
+        latency.stamp(st, "start")  # queue-wait ends: prep begins
         nb = seg_ops.bucket_size(len(batch))
         wb = seg_ops.bucket_size(max(wins))
         vb = batch[0].vb
@@ -477,13 +501,14 @@ class TenantCohort:
                 failed.append((t, "%s: %s" % (type(e).__name__, e)))
             except Exception as e:  # gslint: disable=except-hygiene (captured per tenant: finalize demotes the sick tenant via record_demotion and the cohort keeps dispatching)
                 failed.append((t, "%s: %s" % (type(e).__name__, e)))
-        return (nb, wb, s, d, valid, real, failed)
+        latency.stamp(st, "prep")
+        return (nb, wb, s, d, valid, real, failed, st)
 
     def _dispatch_batch(self, vb: int, kb: int, slab, out: dict,
                         staged: list) -> int:
         """One vmapped cohort dispatch + finalize. Returns the number
         of edges covered (the tuner's measurement unit)."""
-        nb, wb, s, d, valid, real, failed = slab
+        nb, wb, s, d, valid, real, failed, st = slab
         for t, err in failed:
             self._demote(t, "slab prep failed: %s" % err)
         if not real:
@@ -504,16 +529,25 @@ class TenantCohort:
             for leaf in range(3))
         run = self._program(vb, kb, nb, wb)
         edges = sum(n for _t, _row, _w, n in real)
+
+        def _dispatch():
+            # h2d INSIDE the guarded call (a wedged transfer must
+            # surface as the typed StageTimeout, not hang the pump);
+            # the boundary stamp closes the h2d stage right after
+            sj, dj, vj = (jnp.asarray(s), jnp.asarray(d),
+                          jnp.asarray(valid))
+            latency.stamp(st, "h2d")
+            return run(stacked, sj, dj, vj)
+
         with telemetry.span("cohort.dispatch", tenants=len(real),
                             windows=sum(w for _t, _r, w, _n in real),
                             edges=edges):
             faults.fire("cohort_dispatch")
             new_carries, outs = resilience.call_guarded(
-                "dispatch", ("cohort", self._round_no),
-                lambda: run(stacked, jnp.asarray(s), jnp.asarray(d),
-                            jnp.asarray(valid)),
+                "dispatch", ("cohort", self._round_no), _dispatch,
                 retries=0)  # carry-mutating: deadline only, never re-run
         mats = tuple(np.array(x) for x in outs)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per dispatch)
+        latency.stamp(st, "dispatch")  # device wait ends with the d2h
         mdeg, ncomp, odd, tri, ovf = mats
         for t, row, w, n in real:
             summaries = []
@@ -534,6 +568,17 @@ class TenantCohort:
             t.src = t.src[n:]
             t.dst = t.dst[n:]
             t.bp_stamped = False  # queue drained: new overflow episode
+            if st is not None:
+                # per-window ingest→deliver record: join each window
+                # back to the admission mark of its completing edge;
+                # the serving front-end defers emission to its sink
+                # write (serve._emit stamps the `deliver` stage)
+                for j in range(w):
+                    latency.on_window(
+                        t.tid,
+                        edges=min((j + 1) * self.eb, n) - j * self.eb,
+                        st=st, ordinal=t.windows_done + j,
+                        defer=self.defer_delivery)
             t.windows_done += w
             if n < w * self.eb:      # the final short window just cut
                 t.closed_partial = True
@@ -544,6 +589,10 @@ class TenantCohort:
                                 tenant=t.tid)
             metrics.gauge_set("gs_tenant_queue_edges", t.queued,
                               tenant=t.tid)
+            if st is not None:
+                metrics.gauge_set("gs_tenant_queue_age_s",
+                                  latency.queue_age(t.tid) or 0.0,
+                                  tenant=t.tid)
             self._stage_ckpt(t, staged)
         return edges
 
@@ -658,6 +707,10 @@ class TenantCohort:
             t = self.tenants[tid]
             if t.tier != "single" or t.closed:
                 continue
+            # the demoted engine's delivered rows keep the serving
+            # contract: mirror the cohort's delivery deferral per
+            # pump (serve restores defer_delivery=False at drain)
+            t.engine._lat_defer = self.defer_delivery
             n = (t.queued // self.eb) * self.eb
             if t.closing:
                 n = t.queued
@@ -705,6 +758,11 @@ class TenantCohort:
         eng = scan_analytics.StreamSummaryEngine(
             edge_bucket=self.eb, vertex_bucket=t.vb, k_bucket=t.kb)
         eng.load_state_dict(self.tenant_state_dict(t.tid))
+        # latency-plane lane continuity: the demoted engine records
+        # its windows on THIS tenant's lane and must not re-stamp
+        # admission (the cohort's feed() already did at the boundary)
+        eng._lat_lane = t.tid
+        eng._lat_admit = False
         t.engine = eng
         t.tier = "single"
         resilience.record_demotion(
@@ -863,13 +921,17 @@ class TenantCohort:
         offsets = {tid: self.resume_offset(tid)
                    for tid in self.tenants}
         replayed: Dict[str, int] = {}
-        for tid, _start, src, dst, _ts in wal_mod.replay(
+        for tid, _start, src, dst, ts in wal_mod.replay(
                 self._wal_dir, offsets):
             t = self.tenants.get(tid)
             if t is None or t.closed:
                 continue
             t.src = np.concatenate([t.src, src])
             t.dst = np.concatenate([t.dst, dst])
+            # re-seed the latency plane's admission marks with the
+            # journaled ORIGINAL stamps: the replayed windows report
+            # their honest, larger latency, never reset-to-zero
+            latency.on_replay(tid, len(src), ts)
             replayed[tid] = replayed.get(tid, 0) + len(src)
         telemetry.event("wal_replayed", durable=True,
                         component="cohort", dir=self._wal_dir,

@@ -605,9 +605,23 @@ def run_pipeline(items: Iterable, prep: Callable, h2d: Callable,
                 if nxt < len(items):
                     futures.append(_submit(items[nxt]))
                     nxt += 1
-                # backlog gauge for the health plane (no-op disarmed):
-                # prepped+transferred chunks waiting on dispatch
+                # backlog gauges for the health plane (no-op
+                # disarmed): prepped+transferred chunks waiting on
+                # dispatch, plus the AGE of the oldest one — depth
+                # says how much is queued, age says how long the head
+                # of the line has already waited (the pipeline-level
+                # twin of the per-tenant queue-age gauge)
                 metrics.gauge_set("gs_inflight_chunks", len(futures))
+                if metrics.enabled():
+                    oldest = (futures[0][1].get("submitted")
+                              if futures else None)
+                    # 0.0 when drained: a scrape after the stream
+                    # finishes must not show age for work that no
+                    # longer exists
+                    metrics.gauge_set(
+                        "gs_inflight_oldest_s",
+                        time.perf_counter() - oldest
+                        if oldest is not None else 0.0)
                 _consume(item, dev, cell.get("tctx"))
     except Exception:
         # drain in-flight device work before surfacing the failure:

@@ -44,6 +44,7 @@ from . import triangles as tri_ops
 from . import unionfind
 from ..utils import checkpoint
 from ..utils import faults
+from ..utils import latency
 from ..utils import metrics
 from ..utils import telemetry
 from ..utils import wal as wal_mod
@@ -181,6 +182,21 @@ class SummaryEngineBase:
             # auto-checkpoint config survives reset() like the timers
             self._ckpt_path = None
             self._ckpt_policy = None
+        if not hasattr(self, "_lat_lane"):
+            # latency-plane lane of this engine's windows; a cohort
+            # demotion re-points it at the tenant (core/tenancy),
+            # clears _lat_admit (the cohort's feed() already stamped
+            # admission at the serving boundary) and mirrors the
+            # cohort's delivery deferral per pump
+            self._lat_lane = None
+            self._lat_admit = True
+            self._lat_defer = False
+        # per-chunk stage-boundary stamps keyed by chunk start
+        # (filled by the dispatch closure, drained by
+        # _finalize_summaries). Cleared on EVERY reset — a stamp
+        # stranded by a mid-call failure must never join a later
+        # run's window at the same chunk offset.
+        self._lat_stamps = {}
         if not hasattr(self, "_wal"):
             # write-ahead journal config survives reset() too
             self._wal = None
@@ -352,12 +368,17 @@ class SummaryEngineBase:
             return []
         off = self.resume_offset()
         parts_s, parts_d = [], []
-        for tid, _start, src, dst, _ts in wal_mod.replay(
+        for tid, _start, src, dst, ts in wal_mod.replay(
                 self._wal_dir, {self._wal_tenant: off}):
             if tid != self._wal_tenant:
                 continue
             parts_s.append(src)
             parts_d.append(dst)
+            # re-seed the latency plane's admission marks with the
+            # journaled ORIGINAL stamps (latency.window records of the
+            # replayed windows report honest, larger latency)
+            latency.on_replay(self._lat_lane or self._wal_tenant,
+                              len(src), ts)
         edges = sum(len(s) for s in parts_s)
         telemetry.event("wal_replayed", durable=True,
                         component="engine", dir=self._wal_dir,
@@ -367,13 +388,16 @@ class SummaryEngineBase:
             return []
         # suspend journaling for the replay feed: these edges are
         # already in the journal — re-appending would double them on
-        # the NEXT recovery
+        # the NEXT recovery. Admission is likewise suspended: the
+        # marks above already carry the ORIGINAL stamps.
         live, self._wal = self._wal, None
+        admit_prev, self._lat_admit = self._lat_admit, False
         try:
             return self.process(np.concatenate(parts_s),
                                 np.concatenate(parts_d))
         finally:
             self._wal = live
+            self._lat_admit = admit_prev
 
     def resume_offset(self) -> int:
         """Edges already folded into the carried state: a resumed
@@ -423,6 +447,8 @@ class SummaryEngineBase:
         its partial trailing window (count-based tumbling semantics),
         so it must be the stream's final call — feed mid-stream chunks
         in edge_bucket multiples (enforced below)."""
+        lat = latency.enabled()
+        t_admit = latency.clock() if lat else 0.0
         metrics.on_stream_start(type(self).__name__)
         src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
@@ -438,9 +464,22 @@ class SummaryEngineBase:
             # journal-before-fold: the edges are durable before any
             # dispatch touches the carry, so a kill mid-call replays
             # them from resume_offset() (the wal_enqueue fault site
-            # pins the append→fold gap in tests)
-            self._wal.append(self._wal_tenant, src, dst)
+            # pins the append→fold gap in tests). Armed, the batch's
+            # admission stamp rides the ts column so replayed windows
+            # keep their original admission time.
+            self._wal.append(
+                self._wal_tenant, src, dst,
+                np.full(n, latency.admit_ns(t_admit), np.int64)
+                if lat else None)
             faults.fire("wal_enqueue", self._wal_tenant)
+        if lat and self._lat_admit:
+            latency.on_admit(self._lat_lane or self._wal_tenant, n,
+                             t0=t_admit)
+        if self._lat_stamps:
+            # stamps stranded by a failed earlier call (dispatch ran,
+            # finalize never did) must not join THIS call's windows
+            # at the same chunk offsets
+            self._lat_stamps.clear()
         self._closed_partial = n % self.eb != 0
         num_w = -(-n // self.eb)
         out = []
@@ -514,6 +553,20 @@ class SummaryEngineBase:
                 "odd_cycle": bool(odd[w]),
                 "triangles": int(tri[w]),  # gslint: disable=host-sync (numpy-on-numpy after _materialize)
             })
+        if latency.enabled():
+            # per-window ingest→deliver record (deliver = finalize on
+            # the engine path: summaries are handed to the caller at
+            # the very next return) — joined to the chunk's boundary
+            # stamps collected by the pipeline closures
+            st = self._lat_stamps.pop(f_at, None)
+            lane = self._lat_lane or self._wal_tenant
+            for w in range(f_real):
+                lo_w = (f_at + w) * self.eb
+                latency.on_window(
+                    lane,
+                    edges=min(lo_w + self.eb, len(src)) - lo_w,
+                    st=st, ordinal=self.windows_done + w,
+                    defer=self._lat_defer)
         self.windows_done += f_real
         # window-finalize mark (utils/metrics): throughput counters +
         # the staleness clock the health watchdog reads
@@ -535,6 +588,8 @@ class SummaryEngineBase:
         the triangle _run_stack_loop). `data` is the prebuilt
         whole-stream stack in the chunk's wire format."""
         def prep(at):
+            st = latency.stamps()
+            latency.stamp(st, "start")  # queue-wait ends here
             hi = min(at + wb, hi_w)
             # ragged tails pad the window axis to a power-of-two bucket
             # (all-invalid rows fold as no-ops against the carry), so
@@ -552,34 +607,45 @@ class SummaryEngineBase:
                         src[lo:hi_e], dst[lo:hi_e], self.eb)
                     sc, dc, nvc, real = compact_ingress.pad_chunk(
                         s16, d16, nv, 0, m, wb, self.eb)
-                    return at, real, (sc, dc, nvc)
+                    latency.stamp(st, "prep")
+                    return at, real, (sc, dc, nvc), st
                 m, s, d, valid = seg_ops.window_stack(
                     src[lo:hi_e], dst[lo:hi_e], self.eb,
                     sentinel=self.vb)
                 sc, dc, vc, real = seg_ops.pad_window_chunk(
                     s, d, valid, 0, m, wb, self.eb, self.vb)
-                return at, real, (sc, dc, vc)
+                latency.stamp(st, "prep")
+                return at, real, (sc, dc, vc), st
             if compact:
                 from . import compact_ingress
 
                 s16, d16, nv = data
                 sc, dc, nvc, real = compact_ingress.pad_chunk(
                     s16, d16, nv, at, hi, wb, self.eb)
-                return at, real, (sc, dc, nvc)
+                latency.stamp(st, "prep")
+                return at, real, (sc, dc, nvc), st
             s, d, valid = data
             sc, dc, vc, real = seg_ops.pad_window_chunk(
                 s, d, valid, at, hi, wb, self.eb, self.vb)
-            return at, real, (sc, dc, vc)
+            latency.stamp(st, "prep")
+            return at, real, (sc, dc, vc), st
 
         def h2d(payload):
-            at, real, args = payload
-            return at, real, self._h2d(args)
+            at, real, args, st = payload
+            dev = self._h2d(args)
+            latency.stamp(st, "h2d")
+            return at, real, dev, st
 
         def dispatch(dev_payload):
-            at, real, dev = dev_payload
+            at, real, dev, st = dev_payload
             self._stage_ckpt_at(base, at, staged)
             raw = (self._dispatch_async_compact(*dev) if compact
                    else self._dispatch_async(*dev))
+            latency.stamp(st, "dispatch")
+            if st is not None:
+                # the finalize stage runs one chunk behind dispatch:
+                # park the boundary stamps for _finalize_summaries
+                self._lat_stamps[at] = st
             return at, real, raw
 
         def finalize(item):

@@ -426,6 +426,44 @@ register("GS_SERVE_IDLE_S", "float", 60.0, lo=0.1,
               "wedge the pump",
          default_text="60")
 
+# end-to-end latency plane (utils/latency.py)
+register("GS_LATENCY", "bool", False,
+         help="arm the ingest→deliver latency plane "
+              "(`utils/latency.py`): admission stamps on every "
+              "accepted edge batch (carried through the WAL ts "
+              "column so replayed windows keep their original "
+              "admission time), per-window stage waterfalls, "
+              "per-tenant latency percentiles, the "
+              "oldest-unfinalized-edge age gauge and the SLO burn "
+              "module; off (the default) every hook is a guarded "
+              "no-op and the hot path is bit-identical",
+         default_text="0 (off)")
+register("GS_LAT_MARKS", "int", 4096, lo=16,
+         help="per-lane admission-mark memory bound (batches "
+              "remembered between admission and window finalize); a "
+              "window whose mark was evicted reports an approximate, "
+              "conservative latency instead of growing memory")
+register("GS_LAT_PENDING", "int", 1024, lo=16,
+         help="bounded finalized-but-undelivered window records the "
+              "serving front-end may hold between pump and sink "
+              "write; past it the oldest emits as-finalized")
+register("GS_SLO_P99_S", "float", 0.0, lo=0.0,
+         help="delivered-window end-to-end latency target "
+              "(seconds): each window past it burns the error "
+              "budget; 0 (default) disables the SLO module",
+         default_text="0 (off)")
+register("GS_SLO_BUDGET", "float", 0.01, lo=1e-6, hi=1.0,
+         help="error budget: the allowed fraction of delivered "
+              "windows over the GS_SLO_P99_S target")
+register("GS_SLO_WINDOW_S", "float", 60.0, lo=1.0,
+         help="sliding window (seconds) the SLO burn rate is "
+              "measured over")
+register("GS_SLO_BURN", "float", 2.0, lo=0.1,
+         help="burn rate ((bad/total)/budget) at or above which the "
+              "`/healthz` `latency` section flips `degraded` with a "
+              "durable `slo_burn` event (once per episode; recovery "
+              "stamps `slo_recovered`)")
+
 # program cost observatory (utils/costmodel.py)
 register("GS_COSTMODEL", "bool", False,
          help="arm the program cost observatory "

@@ -1,0 +1,486 @@
+"""End-to-end latency plane (utils/latency.py).
+
+Contracts pinned here:
+- the stage waterfall is CONSERVATIVE: per-window stage latencies sum
+  to the measured ingest→deliver end-to-end exactly (the identity
+  tools/latency_report.py re-checks from ledgers within 5%);
+- batch→window membership joins each finalized window back to the
+  admission stamp of the edge that completed it, across the cohort,
+  the engine, and the driver paths;
+- `GS_LATENCY=0` digest parity: summaries, WindowResult fields, serve
+  rows and WAL bytes are bit-identical to a plane-less build (the
+  zero-overhead contract; the ≤1.05× armed bar is committed to
+  PERF_cpu.json's `latency` section and re-checked here);
+- kill→WAL-replay recovery preserves admission timestamps (honest,
+  larger latency — never reset-to-zero);
+- the SLO module burns the error budget, flips the `/healthz`
+  `latency` section degraded on sustained burn (durable `slo_burn`),
+  and recovers;
+- tools/latency_report.py exits non-zero on unaccounted time.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.tenancy import TenantCohort
+from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+from gelly_streaming_tpu.utils import knobs, latency, metrics, telemetry
+
+EB, VB = 128, 256
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    monkeypatch.setenv("GS_LATENCY", "1")
+    latency.reset()
+    yield
+    latency.reset()
+
+
+def make_edges(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, VB, n, dtype=np.int32),
+            rng.integers(0, VB, n, dtype=np.int32))
+
+
+# ----------------------------------------------------------------------
+# plane semantics
+# ----------------------------------------------------------------------
+def test_disarmed_is_inert(monkeypatch):
+    monkeypatch.setenv("GS_LATENCY", "0")
+    latency.reset()
+    assert latency.on_admit("t", 10) is None
+    assert latency.on_window("t", edges=10) is None
+    assert latency.stamps() is None
+    latency.stamp(None, "prep")  # no-op by contract
+    assert latency.queue_age("t") is None
+    assert latency.oldest_age() is None
+    assert latency.health_section() == {"enabled": False}
+    assert latency.percentile_fields() == {}
+    assert latency.recent() == []
+
+
+def test_waterfall_sums_to_e2e_exactly(armed):
+    t0 = latency.clock()
+    latency.on_admit("t", 100, t0=t0)
+    st = latency.stamps()
+    for key in ("start", "prep", "h2d", "dispatch"):
+        latency.stamp(st, key)
+    rec = latency.on_window("t", edges=100, st=st, ordinal=0)
+    assert set(rec["stages"]) == {"admission", "queue_wait", "prep",
+                                  "h2d", "dispatch", "finalize"}
+    assert sum(rec["stages"].values()) == pytest.approx(
+        rec["e2e_s"], abs=1e-12)
+    assert rec["e2e_s"] >= 0
+    assert not rec["replayed"]
+
+
+def test_window_joins_completing_batch(armed):
+    # two batches; the first window (5 edges) completes inside batch
+    # 1, the second (5 edges) needs batch 2 — each window's admission
+    # anchor is its COMPLETING batch's stamp
+    t1 = latency.clock() - 1.0
+    t2 = latency.clock() - 0.2
+    latency.on_admit("t", 6, t0=t1, t1=t1)
+    latency.on_admit("t", 4, t0=t2, t1=t2)
+    w1 = latency.on_window("t", edges=5)
+    w2 = latency.on_window("t", edges=5)
+    assert w1["e2e_s"] == pytest.approx(
+        latency.clock() - t1, abs=0.05)
+    assert w2["e2e_s"] == pytest.approx(
+        latency.clock() - t2, abs=0.05)
+    assert w1["e2e_s"] > w2["e2e_s"]
+
+
+def test_queue_age_tracks_oldest_unfinalized(armed):
+    t0 = latency.clock() - 2.0
+    latency.on_admit("t", 10, t0=t0, t1=t0)
+    age = latency.queue_age("t")
+    assert age == pytest.approx(2.0, abs=0.2)
+    assert latency.oldest_age() == pytest.approx(age, abs=0.2)
+    latency.on_window("t", edges=10)
+    assert latency.queue_age("t") is None  # fully finalized
+
+
+def test_deferred_delivery_and_settle(armed):
+    latency.on_admit("t", 10)
+    rec = latency.on_window("t", edges=10, ordinal=7, defer=True)
+    assert latency.recent() == []  # not emitted yet
+    time.sleep(0.01)
+    done = latency.delivered("t", 7)
+    assert done is rec
+    assert done["stages"]["deliver"] >= 0.01
+    assert sum(done["stages"].values()) == pytest.approx(
+        done["e2e_s"], abs=1e-12)
+    assert latency.delivered("t", 7) is None  # already taken
+    # settle() emits what was never delivered
+    latency.on_admit("t", 5)
+    latency.on_window("t", edges=5, ordinal=8, defer=True)
+    assert latency.settle() == 1
+    assert len(latency.recent()) == 2
+
+
+def test_lane_cardinality_bound(armed, monkeypatch):
+    monkeypatch.setenv("GS_METRICS_SERIES", "2")
+    for i in range(5):
+        latency.on_admit("lane-%d" % i, 1)
+        latency.on_window("lane-%d" % i, edges=1)
+    sec = latency.health_section()
+    assert len(sec["tenants"]) <= 3  # 2 lanes + the overflow row
+    assert "overflow" in sec["tenants"]
+
+
+def test_mark_memory_bounded(armed, monkeypatch):
+    monkeypatch.setenv("GS_LAT_MARKS", "16")
+    latency.reset()
+    for _ in range(100):
+        latency.on_admit("t", 1)
+    # the window whose mark was evicted still records, flagged approx
+    rec = latency.on_window("t", edges=1)
+    assert rec.get("approx") is True
+
+
+def test_replay_marks_preserve_original_time(armed):
+    old = latency.clock() - 3.0
+    latency.on_replay("t", 10, np.array([int(old * 1e9)] * 10))
+    rec = latency.on_window("t", edges=10)
+    assert rec["replayed"] is True
+    assert rec["e2e_s"] == pytest.approx(3.0, abs=0.2)
+
+
+# ----------------------------------------------------------------------
+# SLO burn
+# ----------------------------------------------------------------------
+def test_slo_burn_flip_and_recover(armed, monkeypatch, tmp_path):
+    monkeypatch.setenv("GS_SLO_P99_S", "0.5")
+    monkeypatch.setenv("GS_SLO_BUDGET", "0.1")
+    monkeypatch.setenv("GS_SLO_BURN", "2.0")
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.setenv("GS_TRACE_DIR", str(tmp_path))
+    telemetry.reset()
+    try:
+        old = latency.clock() - 2.0  # every window blows the target
+        for i in range(10):
+            latency.on_admit("t", 1, t0=old, t1=old)
+            latency.on_window("t", edges=1)
+        sec = latency.health_section()
+        assert sec["status"] == "degraded"
+        assert sec["slo"]["burn_rate"] >= 2.0
+        telemetry.flush()
+        ledger = telemetry.ledger_path()
+        events = [json.loads(line)["name"]
+                  for line in open(ledger) if line.strip()
+                  if json.loads(line).get("t") == "event"]
+        assert events.count("slo_burn") == 1  # once per episode
+        # recovery: fast windows dilute the burn below threshold
+        for i in range(200):
+            latency.on_admit("t", 1)
+            latency.on_window("t", edges=1)
+        assert latency.health_section()["status"] == "ok"
+        telemetry.flush()
+        events = [json.loads(line)["name"]
+                  for line in open(ledger) if line.strip()
+                  if json.loads(line).get("t") == "event"]
+        assert "slo_recovered" in events
+    finally:
+        telemetry.reset()
+
+
+# ----------------------------------------------------------------------
+# instrumented paths
+# ----------------------------------------------------------------------
+def test_engine_records_reconcile(armed):
+    src, dst = make_edges(4 * EB)
+    eng = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+    out = eng.process(src, dst)
+    recs = latency.recent()
+    assert len(recs) == len(out) == 4
+    for rec in recs:
+        assert set(rec["stages"]) >= {"admission", "queue_wait",
+                                      "prep", "h2d", "dispatch",
+                                      "finalize"}
+        assert sum(rec["stages"].values()) == pytest.approx(
+            rec["e2e_s"], abs=1e-9)
+    assert [r["window"] for r in recs] == [0, 1, 2, 3]
+
+
+def test_cohort_records_and_ordinals(armed):
+    src, dst = make_edges(3 * EB, seed=1)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("a")
+    co.feed("a", src, dst)
+    out = co.pump()
+    assert len(out["a"]) == 3
+    recs = latency.recent()
+    assert [r["window"] for r in recs] == [0, 1, 2]
+    assert all(r["tenant"] == "a" for r in recs)
+    for rec in recs:
+        assert sum(rec["stages"].values()) == pytest.approx(
+            rec["e2e_s"], abs=1e-9)
+
+
+def test_cohort_replay_preserves_admission(armed, tmp_path):
+    src, dst = make_edges(2 * EB, seed=2)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    assert co.enable_wal(str(tmp_path))
+    co.admit("t")
+    co.feed("t", src, dst)
+    co._wal.close()  # crash before any pump
+    time.sleep(0.2)
+    latency.reset()  # fresh plane = the new-process shape
+    co2 = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    assert co2.enable_wal(str(tmp_path))
+    co2.recover()
+    out = co2.pump()
+    assert len(out["t"]) == 2
+    recs = latency.recent()
+    assert all(r["replayed"] for r in recs)
+    assert all(r["e2e_s"] >= 0.2 for r in recs), \
+        "replayed windows reset their admission time"
+
+
+def test_demoted_tenant_keeps_its_lane(armed):
+    src, dst = make_edges(2 * EB, seed=3)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    co.feed("t", src, dst)
+    co.demote("t", reason="test")
+    out = co.pump()
+    assert len(out["t"]) == 2
+    recs = latency.recent()
+    assert len(recs) == 2
+    assert all(r["tenant"] == "t" for r in recs)
+    # the single-tenant engine must NOT have re-stamped admission:
+    # the lane's fed cursor still equals what feed() admitted
+    assert latency.queue_age("t") is None
+
+
+def test_driver_attaches_window_records(armed):
+    from gelly_streaming_tpu.core.driver import (
+        StreamingAnalyticsDriver)
+
+    src, dst = make_edges(4 * EB, seed=4)
+    drv = StreamingAnalyticsDriver(window_ms=1000, vertex_bucket=VB,
+                                   edge_bucket=EB)
+    results = drv.run_arrays(src.astype(np.int64),
+                             dst.astype(np.int64))
+    assert len(results) == 4
+    for res in results:
+        assert res.latency is not None
+        assert sum(res.latency["stages"].values()) == pytest.approx(
+            res.latency["e2e_s"], abs=1e-9)
+
+
+# ----------------------------------------------------------------------
+# zero-overhead / digest parity
+# ----------------------------------------------------------------------
+def test_disarmed_digest_parity(monkeypatch):
+    src, dst = make_edges(4 * EB, seed=5)
+    monkeypatch.setenv("GS_LATENCY", "0")
+    latency.reset()
+    base_eng = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+    base = base_eng.process(src, dst)
+    assert latency.recent() == []
+
+    monkeypatch.setenv("GS_LATENCY", "1")
+    latency.reset()
+    armed_eng = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+    got = armed_eng.process(src, dst)
+    assert got == base  # summaries bit-identical armed or not
+    latency.reset()
+
+
+def test_disarmed_driver_has_no_latency_field(monkeypatch):
+    from gelly_streaming_tpu.core.driver import (
+        StreamingAnalyticsDriver)
+
+    monkeypatch.setenv("GS_LATENCY", "0")
+    latency.reset()
+    src, dst = make_edges(2 * EB, seed=6)
+    drv = StreamingAnalyticsDriver(window_ms=1000, vertex_bucket=VB,
+                                   edge_bucket=EB)
+    for res in drv.run_arrays(src.astype(np.int64),
+                              dst.astype(np.int64)):
+        assert res.latency is None
+
+
+def test_wal_bytes_identical_disarmed(monkeypatch, tmp_path):
+    # the journal of a disarmed run must carry NO ts column — byte
+    # parity with a plane-less build
+    from gelly_streaming_tpu.utils import wal as wal_mod
+
+    monkeypatch.setenv("GS_LATENCY", "0")
+    latency.reset()
+    src, dst = make_edges(EB, seed=7)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    assert co.enable_wal(str(tmp_path))
+    co.admit("t")
+    co.feed("t", src, dst)
+    co._wal.close()
+    for _tid, _start, _s, _d, ts in wal_mod.replay(str(tmp_path)):
+        assert ts is None
+
+
+# ----------------------------------------------------------------------
+# serve rows / status (the self-throttle satellite)
+# ----------------------------------------------------------------------
+def test_serve_rows_carry_latency_fields(armed):
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+
+    src, dst = make_edges(2 * EB, seed=8)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    server = StreamServer(co, port=0).start()
+    cli = ServeClient(server.port)
+    try:
+        assert cli.admit("t1")["ok"]
+        assert cli.feed("t1", src.tolist(), dst.tolist())["ok"]
+        rows = cli.pump()["results"]["t1"]
+        assert all("latency_s" in r and "queue_edges" in r
+                   for r in rows)
+        assert all(r["latency_s"] > 0 for r in rows)
+        status = cli.status()["serve"]
+        assert status["queues"]["t1"]["edges"] == 0
+        assert status["latency"]["enabled"] is True
+        assert "t1" in status["latency"]["tenants"]
+    finally:
+        cli.close()
+        server.close()
+
+
+def test_demoted_tenant_rows_keep_latency_fields(armed):
+    # the engine path honors the cohort's delivery deferral, so a
+    # demoted tenant's served rows still carry the self-throttle
+    # fields (review-hardened)
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+
+    src, dst = make_edges(2 * EB, seed=9)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    server = StreamServer(co, port=0).start()
+    cli = ServeClient(server.port)
+    try:
+        assert cli.admit("t1")["ok"]
+        co.demote("t1", reason="test")
+        assert cli.feed("t1", src.tolist(), dst.tolist())["ok"]
+        rows = cli.pump()["results"]["t1"]
+        assert len(rows) == 2
+        assert all("latency_s" in r and "queue_edges" in r
+                   for r in rows), rows
+    finally:
+        cli.close()
+        server.close()
+    # close() restored the direct-pump shape, lane-scoped
+    assert co.defer_delivery is False
+
+
+def test_stale_stamps_cleared_on_reset_and_next_call(armed):
+    src, dst = make_edges(2 * EB, seed=10)
+    eng = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+    eng._lat_stamps[0] = {"start": 0.0}  # stranded by a failed call
+    eng.reset()
+    assert eng._lat_stamps == {}
+    eng._lat_stamps[0] = {"start": 0.0}
+    eng.process(src, dst)  # clears stranded stamps before joining
+    recs = latency.recent()
+    # the stranded all-zero boundary never joined: stages stay sane
+    assert all(sum(r["stages"].values()) == pytest.approx(
+        r["e2e_s"], abs=1e-9) for r in recs)
+    assert all(r["stages"].get("queue_wait", 0) < 60
+               for r in recs)
+
+
+# ----------------------------------------------------------------------
+# tools: latency_report reconciliation
+# ----------------------------------------------------------------------
+def _ledger_line(tenant, window, e2e, stages):
+    return json.dumps({
+        "t": "event", "name": "latency.window", "trace": "x",
+        "a": {"tenant": tenant, "window": window, "edges": 10,
+              "e2e_s": e2e, "stages": stages}})
+
+
+def test_latency_report_clean_and_violation(tmp_path):
+    from tools import latency_report
+
+    good = tmp_path / "good.jsonl"
+    good.write_text("\n".join([
+        _ledger_line("t", 0, 1.0, {"admission": 0.2, "dispatch": 0.5,
+                                   "finalize": 0.3}),
+        _ledger_line("t", 1, 0.5, {"admission": 0.1, "dispatch": 0.3,
+                                   "finalize": 0.1}),
+    ]) + "\n")
+    assert latency_report.main([str(good)]) == 0
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(_ledger_line(
+        "t", 0, 1.0, {"admission": 0.1, "finalize": 0.1}) + "\n")
+    assert latency_report.main([str(bad)]) == 1  # unaccounted time
+
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert latency_report.main([str(empty)]) == 2
+
+
+def test_latency_report_rollup_and_tenant_filter(tmp_path, capsys):
+    from tools import latency_report
+
+    path = tmp_path / "l.jsonl"
+    path.write_text("\n".join(
+        [_ledger_line("a", i, 0.1 * (i + 1),
+                      {"admission": 0.1 * (i + 1)})
+         for i in range(4)]
+        + [_ledger_line("b", 0, 9.0, {"admission": 9.0})]) + "\n")
+    assert latency_report.main([str(path), "--tenant", "a",
+                                "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["windows"] == 4
+    assert list(out["rollup"]) == ["a"]
+    assert out["rollup"]["a"]["e2e_p99_s"] == pytest.approx(0.4)
+
+
+# ----------------------------------------------------------------------
+# knobs & committed evidence
+# ----------------------------------------------------------------------
+def test_latency_knobs_registered():
+    for name in ("GS_LATENCY", "GS_LAT_MARKS", "GS_LAT_PENDING",
+                 "GS_SLO_P99_S", "GS_SLO_BUDGET", "GS_SLO_WINDOW_S",
+                 "GS_SLO_BURN"):
+        assert name in knobs.REGISTRY, name
+
+
+def test_committed_latency_section_meets_the_bar():
+    """PERF_cpu.json's `latency` section is this plane's acceptance
+    bar: parity true, armed overhead ≤ 1.05×, waterfalls reconciled
+    on the 524K/32768 row."""
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "PERF_cpu.json")
+    with open(path) as f:
+        perf = json.load(f)
+    meta = perf.get("latency")
+    assert meta is not None, "PERF_cpu.json has no latency section"
+    assert meta["parity"] is True
+    assert meta["overhead_ratio"] <= 1.05
+    assert meta["edge_bucket"] == 32768
+    assert meta["num_edges"] == 524288
+    assert meta["reconciled_windows"] >= 16
+    assert meta["max_unaccounted_frac"] <= 0.05
+    assert meta["e2e_p99_s"] > 0
+
+
+def test_healthz_latency_section_registered(monkeypatch):
+    monkeypatch.setenv("GS_METRICS", "1")
+    monkeypatch.setenv("GS_LATENCY", "1")
+    metrics.reset()
+    latency.reset()
+    latency.on_admit("t", 1)
+    latency.on_window("t", edges=1)
+    snap = metrics.health_snapshot()
+    assert snap["latency"]["enabled"] is True
+    assert "t" in snap["latency"]["tenants"]
+    metrics.reset()
+    latency.reset()

@@ -17,7 +17,10 @@ Rows are matched by their stable identity (the bench `metric` string,
 or section + probe/bucket keys), and every shared throughput field
 (`value`, `*_edges_per_s`) plus `pipeline_speedup` / `speedup` /
 `vs_baseline` is compared: current/baseline below `1 - tolerance` is
-a regression. The bench rows on this host historically swing with
+a regression. Latency identities invert: every shared
+`*_p{50,95,99}_s` field (bench serving rows, the PERF `latency`
+section) regresses when current/baseline EXCEEDS `1 + tolerance` —
+lower is better there. The bench rows on this host historically swing with
 load (bench.py medians exist for that reason), so the default
 tolerance is deliberately wide (--tolerance 0.2 = flag >20% drops);
 CI that controls its host can tighten it.
@@ -57,6 +60,13 @@ RATE_FIELDS = (
 )
 RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline",
                 "cohort_speedup")
+
+# latency identities (LOWER is better — the comparison inverts):
+# any field both rows share whose name ends in a percentile-seconds
+# suffix is compared as current/baseline ABOVE 1 + tolerance = a
+# latency regression. bench.py serving rows emit serve_e2e_p{50,95,
+# 99}_s and PERF latency sections emit e2e_p{50,95,99}_s.
+LATENCY_SUFFIXES = ("_p50_s", "_p95_s", "_p99_s")
 
 # PERF.json sections that carry comparable rows, with the keys that
 # identify a row within the section
@@ -133,7 +143,7 @@ def extract_rows(doc, label: str) -> dict:
             ident = "%s[%s]" % (section, ",".join(
                 str(row.get(k)) for k in keys))
             add(ident, row)
-    for meta_key in ("telemetry_meta", "metrics"):
+    for meta_key in ("telemetry_meta", "metrics", "latency"):
         meta = doc.get(meta_key)
         if isinstance(meta, dict):
             add(meta_key, meta)
@@ -202,6 +212,26 @@ def compare(base_rows: dict, cur_rows: dict, tolerance: float) -> dict:
                    "ratio": round(ratio, 4)}
             compared.append(row)
             if ratio < 1.0 - tolerance:
+                regressions.append(dict(row, tolerance=tolerance))
+        # latency identities: every shared *_p{50,95,99}_s field,
+        # compared inverted (LOWER is better — current/baseline past
+        # 1 + tolerance is the regression)
+        for field in sorted(k for k in b
+                            if isinstance(k, str)
+                            and k.endswith(LATENCY_SUFFIXES)):
+            bv, cv = b.get(field), c.get(field)
+            if not isinstance(bv, (int, float)) \
+                    or not isinstance(cv, (int, float)) \
+                    or isinstance(bv, bool) or isinstance(cv, bool) \
+                    or bv <= 0:
+                continue
+            ratio = cv / bv
+            row = {"row": ident, "field": field,
+                   "baseline": bv, "current": cv,
+                   "ratio": round(ratio, 4),
+                   "direction": "lower_is_better"}
+            compared.append(row)
+            if ratio > 1.0 + tolerance:
                 regressions.append(dict(row, tolerance=tolerance))
     return {
         "backend": "bench_compare",
@@ -286,6 +316,13 @@ def main(argv=None) -> int:
         print("wrote %s" % args.out, file=sys.stderr)
     if report["regressions"]:
         for r in report["regressions"]:
+            if r.get("direction") == "lower_is_better":
+                print("REGRESSION %s.%s: %s -> %s (x%.3f > 1+%.2f, "
+                      "latency)" % (r["row"], r["field"],
+                                    r["baseline"], r["current"],
+                                    r["ratio"], args.tolerance),
+                      file=sys.stderr)
+                continue
             print("REGRESSION %s.%s: %s -> %s (x%.3f < 1-%.2f)"
                   % (r["row"], r["field"], r["baseline"], r["current"],
                      r["ratio"], args.tolerance), file=sys.stderr)

@@ -878,6 +878,93 @@ def _serve_drain_subleg(workdir, np, streams, oracle, eb, vb,
             "windows": {tid: len(v) for tid, v in final.items()}}
 
 
+def leg_latency(workdir: str) -> dict:
+    """The latency-plane drill (utils/latency.py, GS_LATENCY=1):
+
+      · a journal-armed cohort is fed with the plane armed (admission
+        stamps ride the WAL ts column), then crashes before pumping;
+      · a FRESH cohort (fresh plane — the new-process shape) recovers
+        and pumps: every replayed window's record must carry
+        `replayed=True` and an end-to-end latency AT LEAST the
+        crash→recovery gap — the admission timestamp survived the
+        kill instead of resetting to zero;
+      · each record's stage waterfall still sums to its end-to-end
+        (the conservation contract), and the armed summaries are
+        digest-identical to the fault-free disarmed oracle.
+    """
+    import time
+
+    import numpy as np
+
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.utils import latency
+
+    eb, vb, num_w = 512, 1024, 4
+    s, d = make_stream(num_w * eb, vb, seed=90)
+    s, d = s.astype(np.int32), d.astype(np.int32)
+
+    oracle = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    oracle.admit("t")
+    oracle.feed("t", s, d)
+    want = [_summaries_digest(oracle.pump()["t"])]
+
+    wal_dir = os.path.join(workdir, "latency_wal")
+    gap_s = 0.25
+    prev = os.environ.get("GS_LATENCY")
+    os.environ["GS_LATENCY"] = "1"
+    try:
+        latency.reset()
+        co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        assert co.enable_wal(wal_dir)
+        co.admit("t")
+        co.feed("t", s, d)
+        co._wal.close()  # the crash: queues die with the process
+        time.sleep(gap_s)
+
+        latency.reset()  # the new process starts a fresh plane
+        co2 = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        assert co2.enable_wal(wal_dir)
+        co2.recover()
+        got = co2.pump()["t"]
+        recs = latency.recent()
+        if len(recs) != num_w:
+            raise SystemExit(
+                "chaos latency leg: %d window records, want %d"
+                % (len(recs), num_w))
+        floor = min(r["e2e_s"] for r in recs)
+        preserved = all(r["replayed"] for r in recs) \
+            and floor >= gap_s
+        if not preserved:
+            raise SystemExit(
+                "chaos latency leg: replayed windows lost their "
+                "admission stamps (min e2e %.3fs < %.3fs gap, "
+                "replayed=%s)" % (floor, gap_s,
+                                  [r["replayed"] for r in recs]))
+        for r in recs:
+            ok, gap = latency.reconcile(r)
+            if not ok:
+                raise SystemExit(
+                    "chaos latency leg: replayed window %s does not "
+                    "reconcile (gap %.6fs of %.6fs e2e)"
+                    % (r["window"], gap, r["e2e_s"]))
+        if [_summaries_digest(got)] != want:
+            raise SystemExit("chaos latency leg DIVERGED from the "
+                             "disarmed fault-free oracle")
+    finally:
+        if prev is None:
+            os.environ.pop("GS_LATENCY", None)
+        else:
+            os.environ["GS_LATENCY"] = prev
+        latency.reset()
+    return {
+        "parity": True,
+        "preserved": True,
+        "replayed_windows": len(recs),
+        "min_replay_latency_s": round(floor, 3),
+        "crash_gap_s": gap_s,
+    }
+
+
 def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
              workdir: str) -> dict:
     """The mesh drill: a sharded driver on the virtual CPU mesh takes
@@ -1290,6 +1377,11 @@ def main():
             # record, slow client shed, SIGTERM drain exits 0 with a
             # sealed journal (subprocess)
             sv = leg_serve(workdir)
+            # latency leg: kill→WAL-replay recovery must preserve
+            # admission timestamps — replayed windows report honest,
+            # larger latency (never reset-to-zero) and their stage
+            # waterfalls still reconcile
+            ly = leg_latency(workdir)
             # mesh leg: corrupt wire → retry, dead shard → demotion →
             # parity, n-shard checkpoint → 1-device + host-twin resume
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
@@ -1337,8 +1429,11 @@ def main():
         classes.add("serve_slow_client_shed")
     if sv["drain"]["rc"] == 0 and sv["drain"]["sealed"]:
         classes.add("serve_sigterm_drain")
+    if ly["preserved"]:
+        classes.add("latency_replay_stamps")
     required |= {"serve_kill_replay", "serve_torn_tail",
-                 "serve_slow_client_shed", "serve_sigterm_drain"}
+                 "serve_slow_client_shed", "serve_sigterm_drain",
+                 "latency_replay_stamps"}
     if m is not None:
         for site, _n, action in m["faults_fired"]:
             if action == "corrupt_shard":
@@ -1367,6 +1462,7 @@ def main():
         "health_leg": h,
         "tenancy_leg": tn,
         "serve_leg": sv,
+        "latency_leg": ly,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
         "gslint_leg": gl,

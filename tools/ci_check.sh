@@ -24,6 +24,12 @@
 #      through the interpret-mode fused window megakernel must be
 #      digest-identical to the XLA fused scan, so Pallas API drift
 #      is caught without a chip
+#   8. latency-plane smoke (tools/latency_smoke.py): an armed
+#      loopback serve run must deliver rows with latency_s, populate
+#      the /healthz `latency` section, and leave a ledger whose
+#      per-window stage waterfalls SUM to the measured ingest→deliver
+#      end-to-end within 5% (tools/latency_report.py exits non-zero
+#      otherwise) — at summaries digest-identical to a disarmed run
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -32,30 +38,33 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/7] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/8] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/7] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/8] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/7] gslint =="
+echo "== [2/8] gslint =="
 python -m tools.gslint
 
-echo "== [3/7] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/8] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/7] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/8] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/7] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/8] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
-echo "== [6/7] serve parity smoke (loopback + drain ≡ direct feed) =="
+echo "== [6/8] serve parity smoke (loopback + drain ≡ direct feed) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
-echo "== [7/7] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
+echo "== [7/8] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
 JAX_PLATFORMS=cpu python tools/pallas_smoke.py
+
+echo "== [8/8] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
+JAX_PLATFORMS=cpu python tools/latency_smoke.py
 
 echo "ci_check: all gates green"

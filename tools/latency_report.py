@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Per-window ingest→deliver waterfall renderer + reconciliation
+check over the flight-recorder ledger's `latency.window` events.
+
+The latency plane (utils/latency.py, GS_LATENCY=1) records one event
+per finalized window: its end-to-end ingest→deliver seconds and the
+stage decomposition (admission / queue_wait / prep / h2d / dispatch /
+finalize / deliver) derived from consecutive boundary stamps. Because
+stages are consecutive diffs of ONE clock, they must sum to the
+end-to-end within tolerance — the same conservation discipline
+tools/explain_perf.py holds for cost attribution. This tool:
+
+  - renders one window's life across the stages as an ASCII waterfall
+    (`--tenant T --window N`, or the worst-e2e window by default);
+  - rolls windows up per tenant (`--tenant` filters): count, e2e
+    p50/p95/p99 (`--percentile` picks one), per-stage share;
+  - RECONCILES every window: |sum(stages) − e2e| must stay within
+    `--tolerance` (default 5%) of the end-to-end (with a small
+    absolute floor for µs-scale windows), and no stage may be
+    negative. Any violation → non-zero exit, so CI (gate 8,
+    tools/latency_smoke.py) catches a decomposition that silently
+    stops covering the end-to-end it claims to explain.
+
+Usage:
+  python tools/latency_report.py LEDGER.jsonl [--tenant T]
+         [--window N] [--percentile 99] [--tolerance 0.05] [--json]
+
+The ledger needs GS_TELEMETRY=1 + GS_TRACE_DIR (flushed); a run armed
+with only GS_LATENCY=1 serves /healthz and /metrics but writes no
+ledger rows for this tool.
+
+Exit status: 0 clean, 1 reconciliation violation, 2 usage/no data.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# canonical render order (utils/latency.STAGES without importing the
+# package: this tool must run ledger-only, no jax import)
+STAGES = ("admission", "queue_wait", "prep", "h2d", "dispatch",
+          "finalize", "deliver")
+# reconciliation floor for µs-scale windows — inlined twin of
+# utils/latency.RECONCILE_FLOOR_S / reconcile(); keep in lockstep
+ABS_FLOOR_S = 50e-6
+
+
+def load_windows(path: str) -> list:
+    """The `latency.window` records of one ledger (torn final line
+    tolerated — the telemetry reader discipline)."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail
+            if rec.get("t") == "event" \
+                    and rec.get("name") == "latency.window":
+                a = rec.get("a") or {}
+                if isinstance(a.get("stages"), dict) \
+                        and isinstance(a.get("e2e_s"), (int, float)):
+                    rows.append(a)
+    return rows
+
+
+def reconcile(win: dict, tolerance: float):
+    """(ok, problem_or_None) for one window record: stages must be
+    non-negative and sum to e2e within tolerance."""
+    stages = win["stages"]
+    e2e = float(win["e2e_s"])
+    for name, dur in stages.items():
+        if not isinstance(dur, (int, float)) or dur < 0:
+            return False, "stage %r is negative/non-numeric (%r)" % (
+                name, dur)
+    total = sum(float(v) for v in stages.values())
+    slack = max(tolerance * e2e, ABS_FLOOR_S)
+    if abs(total - e2e) > slack:
+        return False, (
+            "unaccounted time: stages sum to %.6fs but end-to-end is "
+            "%.6fs (|Δ|=%.6fs > %.6fs allowed)"
+            % (total, e2e, abs(total - e2e), slack))
+    return True, None
+
+
+def percentile(samples, p: int) -> float:
+    """Nearest-rank percentile (the telemetry definition, inlined so
+    the tool stays import-light)."""
+    xs = sorted(samples)
+    if not xs:
+        return 0.0
+    rank = max(1, -(-p * len(xs) // 100))
+    return float(xs[min(rank, len(xs)) - 1])
+
+
+def rollup(wins: list, p: int) -> dict:
+    """Per-tenant rows: window count, replayed count, e2e pXX, and
+    per-stage mean share of the end-to-end."""
+    by_tenant = {}
+    for w in wins:
+        by_tenant.setdefault(str(w.get("tenant", "?")), []).append(w)
+    out = {}
+    for tid, rows in sorted(by_tenant.items()):
+        e2es = [float(w["e2e_s"]) for w in rows]
+        total_e2e = sum(e2es) or 1.0
+        stage_totals = {}
+        for w in rows:
+            for name, dur in w["stages"].items():
+                stage_totals[name] = stage_totals.get(name, 0.0) \
+                    + float(dur)
+        out[tid] = {
+            "windows": len(rows),
+            "replayed": sum(1 for w in rows if w.get("replayed")),
+            "e2e_p%d_s" % p: round(percentile(e2es, p), 6),
+            "e2e_p50_s": round(percentile(e2es, 50), 6),
+            "e2e_max_s": round(max(e2es), 6),
+            "stages": {
+                name: {"total_s": round(tot, 6),
+                       "share": round(tot / total_e2e, 4)}
+                for name, tot in sorted(
+                    stage_totals.items(),
+                    key=lambda kv: STAGES.index(kv[0])
+                    if kv[0] in STAGES else 99)},
+        }
+    return out
+
+
+def render_waterfall(win: dict, width: int = 44) -> str:
+    """One window's life across the stages as an ASCII waterfall."""
+    e2e = float(win["e2e_s"])
+    lines = [
+        "window %s (tenant %s, %s edges%s)  end-to-end %.3f ms"
+        % (win.get("window", "?"), win.get("tenant", "?"),
+           win.get("edges", "?"),
+           ", replayed" if win.get("replayed") else "", e2e * 1e3)]
+    at = 0.0
+    scale = width / e2e if e2e > 0 else 0.0
+    for name in STAGES:
+        dur = win["stages"].get(name)
+        if dur is None:
+            continue
+        dur = float(dur)
+        lo = int(at * scale)
+        ln = max(1, int(dur * scale)) if dur > 0 else 0
+        bar = " " * lo + "#" * min(ln, width - lo)
+        lines.append("  %-10s %9.3f ms  %4.1f%%  |%-*s|"
+                     % (name, dur * 1e3,
+                        100.0 * dur / e2e if e2e else 0.0,
+                        width, bar))
+        at += dur
+    un = e2e - sum(float(v) for v in win["stages"].values())
+    lines.append("  %-10s %9.3f ms  %4.1f%%"
+                 % ("unaccounted", un * 1e3,
+                    100.0 * un / e2e if e2e else 0.0))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("ledger", help="run ledger (trace_*.jsonl) of a "
+                                   "GS_LATENCY=1 + GS_TELEMETRY=1 run")
+    ap.add_argument("--tenant", default=None,
+                    help="restrict to one tenant's windows")
+    ap.add_argument("--window", type=int, default=None,
+                    help="render this window ordinal's waterfall "
+                         "(default: the worst end-to-end)")
+    ap.add_argument("--percentile", type=int, default=99,
+                    choices=(50, 90, 95, 99),
+                    help="roll-up percentile (default 99)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed |sum(stages) − e2e| as a fraction "
+                         "of e2e (default 0.05)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report")
+    args = ap.parse_args(argv)
+    if not 0 < args.tolerance < 1:
+        print("latency_report: --tolerance must be in (0, 1)",
+              file=sys.stderr)
+        return 2
+
+    try:
+        wins = load_windows(args.ledger)
+    except OSError as e:
+        print("latency_report: %s" % e, file=sys.stderr)
+        return 2
+    if args.tenant is not None:
+        wins = [w for w in wins
+                if str(w.get("tenant")) == args.tenant]
+    if args.window is not None:
+        sel = [w for w in wins if w.get("window") == args.window]
+        if args.tenant is None and len(
+                {str(w.get("tenant")) for w in sel}) > 1:
+            print("latency_report: --window %d matches several "
+                  "tenants — add --tenant" % args.window,
+                  file=sys.stderr)
+            return 2
+    if not wins:
+        print("latency_report: no latency.window records in %s — arm "
+              "GS_LATENCY=1 AND GS_TELEMETRY=1 (+GS_TRACE_DIR) and "
+              "flush the ring" % args.ledger, file=sys.stderr)
+        return 2
+
+    violations = []
+    for w in wins:
+        ok, problem = reconcile(w, args.tolerance)
+        if not ok:
+            violations.append(
+                {"tenant": str(w.get("tenant")),
+                 "window": w.get("window"), "problem": problem})
+
+    roll = rollup(wins, args.percentile)
+    if args.window is not None:
+        focus = next((w for w in wins
+                      if w.get("window") == args.window), None)
+        if focus is None:
+            print("latency_report: window %d not found"
+                  % args.window, file=sys.stderr)
+            return 2
+    else:
+        focus = max(wins, key=lambda w: float(w["e2e_s"]))
+
+    if args.json:
+        print(json.dumps({
+            "ledger": args.ledger,
+            "windows": len(wins),
+            "tolerance": args.tolerance,
+            "rollup": roll,
+            "waterfall": focus,
+            "violations": violations,
+        }, indent=2))
+    else:
+        print(render_waterfall(focus))
+        print()
+        print("per-tenant roll-up (%d windows, p%d):"
+              % (len(wins), args.percentile))
+        for tid, row in roll.items():
+            print("  %-12s %4d windows (%d replayed)  "
+                  "p50 %.3f ms  p%d %.3f ms  max %.3f ms"
+                  % (tid, row["windows"], row["replayed"],
+                     row["e2e_p50_s"] * 1e3, args.percentile,
+                     row["e2e_p%d_s" % args.percentile] * 1e3,
+                     row["e2e_max_s"] * 1e3))
+            for name, srow in row["stages"].items():
+                print("      %-10s %9.3f ms total  %5.1f%%"
+                      % (name, srow["total_s"] * 1e3,
+                         100.0 * srow["share"]))
+    if violations:
+        for v in violations:
+            print("RECONCILIATION FAILED tenant=%s window=%s: %s"
+                  % (v["tenant"], v["window"], v["problem"]),
+                  file=sys.stderr)
+        return 1
+    print("reconciliation ok: %d window(s), stages sum to "
+          "end-to-end within %.0f%%"
+          % (len(wins), 100 * args.tolerance),
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

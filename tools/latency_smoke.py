@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Latency-plane smoke — CI gate 8 (tools/ci_check.sh).
+
+One tenant fed through a REAL loopback socket into an armed
+(GS_LATENCY=1 + GS_TELEMETRY=1 + GS_METRICS=1) journal-backed
+`core/serve.StreamServer`, pumped and drained. Checks, in order:
+
+  1. every delivered results row carries the self-throttle fields
+     (`latency_s`, `queue_edges`) and the `status` op serves the
+     per-tenant queue depth+age and the `latency` section;
+  2. the run's `/healthz` body has a POPULATED `latency` section
+     (per-tenant e2e percentiles, oldest-unfinalized-edge age key,
+     SLO state when a target is set);
+  3. the flushed run ledger reconciles: tools/latency_report.py over
+     the real serve run must find every window's stage decomposition
+     summing to its measured ingest→deliver end-to-end within 5%
+     (non-zero exit otherwise) — the acceptance bar of the latency
+     plane, held on every CI run;
+  4. serve results are digest-identical to the same stream fed with
+     the plane DISARMED (the observation-only contract).
+
+Exit 0 = clean. Runs in seconds on the CPU backend.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+_KNOBS = ("GS_LATENCY", "GS_TELEMETRY", "GS_TRACE_DIR", "GS_METRICS",
+          "GS_SLO_P99_S")
+
+
+def digest_summaries(summaries) -> str:
+    h = hashlib.sha256()
+    for s in summaries:
+        h.update(json.dumps(s, sort_keys=True).encode())
+    return h.hexdigest()[:16]
+
+
+def serve_run(eb, vb, num_w, src, dst, wd=None):
+    """Feed → pump → drain one loopback server; returns (summary rows,
+    full sink rows)."""
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    cohort = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    if wd is not None:
+        cohort.enable_wal(os.path.join(wd, "wal"))
+    server = StreamServer(cohort, port=0).start()
+    cli = ServeClient(server.port)
+    status = None
+    try:
+        assert cli.admit("t1")["ok"]
+        for i in range(num_w):
+            r = cli.feed("t1", src[i * eb:(i + 1) * eb],
+                         dst[i * eb:(i + 1) * eb])
+            assert r.get("ok"), r
+            cli.pump()
+        status = cli.status()["serve"]
+    finally:
+        cli.close()
+    server.drain(deadline_s=5)
+    rows = list(server.results.get("t1", []))
+    server.close()
+    return rows, status
+
+
+def main() -> int:
+    eb, vb, num_w = 512, 1024, 5
+    from bench import make_stream
+
+    src, dst = make_stream(num_w * eb, vb, seed=11)
+    src, dst = src.astype(np.int32), dst.astype(np.int32)
+
+    prev = {k: os.environ.get(k) for k in _KNOBS}
+    from gelly_streaming_tpu.utils import latency, metrics, telemetry
+    try:
+        # disarmed oracle first (fresh planes)
+        for k in _KNOBS:
+            os.environ[k] = "0" if k != "GS_TRACE_DIR" else ""
+        latency.reset(), metrics.reset(), telemetry.reset()
+        base_rows, _ = serve_run(eb, vb, num_w, src, dst)
+        if any("latency_s" in row for row in base_rows):
+            print("latency smoke FAILED: disarmed rows carry "
+                  "latency fields")
+            return 1
+        want = digest_summaries([r["summary"] for r in base_rows])
+
+        with tempfile.TemporaryDirectory(prefix="gs-lat-smoke-") as wd:
+            os.environ["GS_LATENCY"] = "1"
+            os.environ["GS_TELEMETRY"] = "1"
+            os.environ["GS_METRICS"] = "1"
+            os.environ["GS_TRACE_DIR"] = wd
+            os.environ["GS_SLO_P99_S"] = "30"  # populated, not burning
+            latency.reset(), metrics.reset(), telemetry.reset()
+            rows, status = serve_run(eb, vb, num_w, src, dst, wd=wd)
+
+            # 1. self-throttle fields on every delivered row + status
+            missing = [r["window"] for r in rows
+                       if "latency_s" not in r
+                       or "queue_edges" not in r]
+            if missing:
+                print("latency smoke FAILED: rows without latency/"
+                      "queue fields: %s" % missing)
+                return 1
+            if "queues" not in status or "latency" not in status:
+                print("latency smoke FAILED: status lacks queues/"
+                      "latency sections: %s" % sorted(status))
+                return 1
+
+            # 2. /healthz latency section populated
+            snap = metrics.health_snapshot()
+            lat = snap.get("latency") or {}
+            if not lat.get("enabled") \
+                    or "t1" not in lat.get("tenants", {}) \
+                    or "oldest_unfinalized_age_s" not in lat \
+                    or not lat.get("slo"):
+                print("latency smoke FAILED: /healthz latency section "
+                      "not populated: %s" % json.dumps(lat))
+                return 1
+            t1 = lat["tenants"]["t1"]
+            if t1["windows"] != num_w or t1["e2e_p99_s"] <= 0:
+                print("latency smoke FAILED: t1 percentile row is "
+                      "empty: %s" % t1)
+                return 1
+
+            # 3. ledger waterfalls reconcile within 5%
+            telemetry.flush()
+            ledger = telemetry.ledger_path()
+            if ledger is None:
+                print("latency smoke FAILED: no run ledger was "
+                      "written")
+                return 1
+            from tools import latency_report
+
+            rc = latency_report.main([ledger, "--tolerance", "0.05"])
+            if rc != 0:
+                print("latency smoke FAILED: waterfall "
+                      "reconciliation rc=%d" % rc)
+                return 1
+            telemetry.reset()  # close the ledger inside the tempdir
+
+        # 4. armed ≡ disarmed summaries
+        got = digest_summaries([r["summary"] for r in rows])
+        if got != want or len(rows) != len(base_rows):
+            print("latency smoke FAILED: armed digest %s (%d) != "
+                  "disarmed %s (%d)"
+                  % (got, len(rows), want, len(base_rows)))
+            return 1
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        latency.reset(), metrics.reset(), telemetry.reset()
+    print("latency smoke ok: %d windows delivered with latency_s, "
+          "/healthz latency populated, waterfalls reconcile, armed "
+          "≡ disarmed (%s)" % (len(rows), want))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -80,6 +80,14 @@ DICT_SECTIONS = {
     # tools/explain_perf.py drills into
     "cost_model": ("programs", "parity", "edge_bucket", "trace",
                    "ledger"),
+    # latency-plane overhead + reconciliation proof (utils/latency,
+    # tools/profile_kernels.py section_latency): armed-vs-disarmed
+    # wall ratio with digest parity on the 524K/32768 row, plus the
+    # per-window waterfall conservation check (stages sum to e2e) —
+    # the committed evidence for the GS_LATENCY ≤1.05× bar
+    "latency": ("engine", "parity", "overhead_ratio",
+                "disarmed_edges_per_s", "armed_edges_per_s",
+                "reconciled_windows", "e2e_p99_s"),
 }
 
 # per-row required keys of the cost_model section's `programs` list
@@ -227,6 +235,11 @@ _CHAOS_LEGS = {
     # drain digest ≡ keep-running digest)
     "serve_leg": ("parity", "kill", "torn_tail", "slow_client",
                   "drain"),
+    # the latency-plane drill (latency ISSUE): kill→WAL-replay
+    # recovery must preserve admission timestamps — replayed windows
+    # report honest, larger latency, never reset-to-zero — at armed
+    # summaries digest-identical to the fault-free oracle
+    "latency_leg": ("parity", "preserved", "replayed_windows"),
 }
 
 

@@ -1291,6 +1291,87 @@ def section_metrics(results: dict) -> None:
     results["metrics"] = meta
 
 
+def section_latency(results: dict) -> None:
+    """Latency-plane evidence (utils/latency): the armed plane on the
+    524K/32768 fused-scan row must (a) change NO summary — asserted
+    identical to the disarmed run, (b) stay under the 1.05× armed-
+    overhead bar, and (c) RECONCILE — every window's stage waterfall
+    sums to its measured ingest→deliver end-to-end within 5% (the
+    conservation contract tools/latency_report.py re-checks from
+    ledgers). The committed meta is the schema-validated `latency`
+    section (tools/perf_schema.py) the acceptance bar reads; its
+    e2e_p{50,95,99}_s fields feed bench_compare's lower-is-better
+    comparisons."""
+    from bench import make_stream
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+    from gelly_streaming_tpu.utils import latency
+
+    eb, vb = 32768, 65536
+    edges = int(os.environ.get("GS_TELEMETRY_EDGES", 524288))
+    src, dst = make_stream(edges, vb)
+    prev = {k: os.environ.get(k)
+            for k in ("GS_LATENCY", "GS_METRICS", "GS_TELEMETRY")}
+    try:
+        os.environ["GS_LATENCY"] = "0"
+        os.environ["GS_METRICS"] = "0"
+        os.environ["GS_TELEMETRY"] = "0"
+        eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+
+        def run():
+            eng.reset()
+            return eng.process(src, dst)
+
+        base = run()  # warm + baseline summaries
+        off_s = _timeit(run, reps=5, warmup=1)
+        os.environ["GS_LATENCY"] = "1"
+        latency.reset()
+        armed = run()
+        if armed != base:
+            raise AssertionError(
+                "armed latency plane changed the summaries — the "
+                "zero-overhead contract is broken")
+        on_s = _timeit(run, reps=5, warmup=1)
+        recs = latency.recent()
+        if not recs:
+            raise AssertionError("armed run recorded no windows")
+        worst = 0.0
+        for rec in recs:
+            ok, gap = latency.reconcile(rec)
+            if not ok:
+                raise AssertionError(
+                    "waterfall does not reconcile: window %s gap "
+                    "%.6fs of %.6fs e2e" % (rec["window"], gap,
+                                            rec["e2e_s"]))
+            if rec["e2e_s"] > 0:
+                worst = max(worst, gap / rec["e2e_s"])
+        stage_totals = {}
+        for rec in recs:
+            for name, dur in rec["stages"].items():
+                stage_totals[name] = stage_totals.get(name, 0) + dur
+        meta = {
+            "engine": "fused_scan",
+            "edge_bucket": eb, "num_edges": edges,
+            "parity": True,
+            "disarmed_edges_per_s": round(edges / off_s),
+            "armed_edges_per_s": round(edges / on_s),
+            "overhead_ratio": round(on_s / off_s, 3),
+            "reconciled_windows": len(recs),
+            "max_unaccounted_frac": round(worst, 6),
+            "stages_total_s": {k: round(v, 6) for k, v in
+                               sorted(stage_totals.items())},
+            **latency.percentile_fields("e2e"),
+        }
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        latency.reset()
+    results["latency"] = meta
+
+
 def section_cost_model(results: dict) -> None:
     """Program cost observatory evidence (utils/costmodel): capture
     XLA cost_analysis-derived FLOPs/bytes for the three hot stream
@@ -1626,6 +1707,7 @@ SECTIONS = {
     "autotune": section_autotune,
     "telemetry": section_telemetry,
     "metrics": section_metrics,
+    "latency": section_latency,
     "window": section_window,
     "host_stream": section_host_stream,
     "pipeline_stages": section_pipeline,
