@@ -665,7 +665,17 @@ def run_cohort_leg(metric_suffix: str = "") -> None:
     from gelly_streaming_tpu.ops import autotune as _autotune
     from gelly_streaming_tpu.utils import knobs as _knobs
     from gelly_streaming_tpu.utils import latency as _latency
+    from gelly_streaming_tpu.utils import resilience as _resilience
+    from gelly_streaming_tpu.utils import sanitize as _sanitize
     from gelly_streaming_tpu.utils import telemetry as _telemetry
+
+    # robustness counters for the regression sentry: rejected-record
+    # depth of the (possibly disarmed → 0) dead-letter journal, and
+    # bulkhead quarantines recorded this process
+    _dlq = _sanitize.dlq_status()
+    _dlq_records = 0 if _dlq is None else int(_dlq["records"])
+    _quarantines = sum(1 for e in _resilience.demotion_events()
+                       if e.get("to") == "quarantined")
 
     # latency identities of the serving shape: one extra ARMED rep
     # (outside the timed medians — the ≤1.05x overhead must not skew
@@ -704,6 +714,12 @@ def run_cohort_leg(metric_suffix: str = "") -> None:
         # ingest→deliver latency identities (utils/latency, armed
         # parity rep above): lower-is-better in bench_compare
         **lat_fields,
+        # robustness counters (utils/sanitize + the tenancy
+        # bulkhead): a clean serving run rejects nothing and
+        # quarantines no one — bench_compare flags ANY non-zero turn
+        # of either (lower-is-better, zero-baseline strict)
+        "dlq_records": _dlq_records,
+        "quarantines": _quarantines,
         # chosen-knob provenance, like every bench row: what dispatch
         # configuration the cohort actually ran
         "knobs": {"eb": eb, "vb": vb,

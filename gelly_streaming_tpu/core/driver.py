@@ -48,6 +48,7 @@ from ..utils import faults
 from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
+from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
 from ..utils import wal as wal_mod
 from ..utils.interning import make_interner, parallel_intern_arrays
@@ -377,6 +378,9 @@ class StreamingAnalyticsDriver:
         # FLUSH boundaries, floored at the older kept generation
         self._wal_retention = wal_mod.RetentionCursor()
         self._in_stream = 0
+        # cumulative fed edges incl. sanitizer rejects: the DLQ's
+        # source-offset domain for this driver's admission boundary
+        self._fed_edges = 0
         # tier demotion (utils/resilience): a persistent device failure
         # in the batched snapshot path demotes scan→native→host
         # mid-stream instead of killing the job; None = not demoted
@@ -640,6 +644,37 @@ class StreamingAnalyticsDriver:
         # back through event-time windowing), so driver replays
         # re-stamp at the replay moment rather than overload it.
         lat_t0 = latency.clock() if latency.enabled() else None
+        # "admit" fault site + armed sanitizer (utils/sanitize): the
+        # driver's admission boundary. Runs BEFORE the journal below,
+        # so a journaled batch is always clean and replay can never
+        # re-raise a rejection; the keep-mask filters the aligned
+        # ts/_starts columns so event-time windowing stays consistent.
+        # GS_SANITIZE=off (default) skips straight to the legacy path.
+        got = faults.fire("admit", (self.tenant or "driver", src, dst))
+        if got is not None:
+            _t, src, dst = got
+        if sanitize_mod.enabled():
+            try:
+                rep = sanitize_mod.sanitize(
+                    # vb=None: the driver's ids are EXTERNAL int64
+                    # keys the interner densifies (the bucket grows),
+                    # so only representability/policy checks apply
+                    src, dst, None,
+                    tenant=self.tenant or "driver", origin="driver",
+                    offset=self._fed_edges,
+                    dlq=sanitize_mod.resolve_dlq())
+            except sanitize_mod.BatchRejected as e:
+                self._fed_edges += e.size
+                raise
+            self._fed_edges += rep.accepted + rep.rejected
+            src, dst = rep.src, rep.dst
+            if rep.rejected:
+                if ts is not None and len(np.atleast_1d(ts)):
+                    ts = np.asarray(ts)[rep.keep]
+                if _starts is not None:
+                    _starts = np.asarray(_starts)[rep.keep]
+        else:
+            self._fed_edges += len(np.atleast_1d(np.asarray(src)))
         src = np.asarray(src, np.int64)
         dst = np.asarray(dst, np.int64)
 

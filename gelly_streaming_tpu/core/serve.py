@@ -17,7 +17,12 @@ with the pieces a deployable stream service needs and the ROADMAP's
   the SAME GS_STAGE_BACKOFF_S ladder the in-process stage guard
   sleeps, doubling per consecutive rejection and resetting on the
   first accepted feed), so a polite client and the internal retry
-  pace identically.
+  pace identically. With the sanitizer armed (GS_SANITIZE,
+  utils/sanitize): feed replies carry the typed rejection counts of
+  edges peeled off to the dead-letter journal, oversized batches come
+  back as `BatchRejected` with the reason code, a quarantined
+  tenant's refusal surfaces as `TenantQuarantined`, and
+  status//healthz add DLQ depth + the quarantined-tenant list.
 - **Deadlines.** Per-connection idle timeout (GS_SERVE_IDLE_S) on the
   receive side; every response send runs under
   `resilience.call_guarded` with the same deadline and retries=0 — a
@@ -75,6 +80,7 @@ from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
+from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
 from ..utils.faults import InjectedFault
 from .tenancy import TenantBackpressure, TenantCohort, TenantRejected
@@ -311,11 +317,29 @@ class StreamServer:
                     "capacity": e.capacity,
                     "retry_after_s": resilience.backoff_s(n)}
         except TenantRejected as e:
+            # type(e).__name__, not a fixed string: a quarantined
+            # tenant's refusal must surface as TenantQuarantined so
+            # the client can tell the bulkhead from a capacity refusal
             self._stats["rejections"] += 1
             metrics.counter_inc("gs_serve_rejections_total",
-                                kind="TenantRejected")
-            return {"ok": False, "error": "TenantRejected",
+                                kind=type(e).__name__)
+            resp = {"ok": False, "error": type(e).__name__,
                     "tenant": e.tenant, "message": str(e)[:500]}
+            left = getattr(e, "probation_left", None)
+            if left is not None:
+                resp["probation_left"] = left
+            return resp
+        except sanitize_mod.BatchRejected as e:
+            # the sanitizer's whole-batch refusal (GS_MAX_BATCH_EDGES
+            # or a structurally unusable batch): typed, with the
+            # reason code and the journal's recoverability promise
+            self._stats["rejections"] += 1
+            metrics.counter_inc("gs_serve_rejections_total",
+                                kind="BatchRejected")
+            return {"ok": False, "error": "BatchRejected",
+                    "tenant": e.tenant, "reason": e.reason,
+                    "size": e.size, "limit": e.limit,
+                    "message": str(e)[:500]}
         except InjectedFault:
             raise  # the chaos kill must look like a kill, not a 500
         except (ValueError, KeyError, TypeError) as e:
@@ -333,12 +357,35 @@ class StreamServer:
         return {"ok": True, "tenant": str(req["tenant"])}
 
     def _op_feed(self, req: dict) -> dict:
-        src = np.asarray(req["src"], np.int32)
-        dst = np.asarray(req["dst"], np.int32)
+        if sanitize_mod.enabled():
+            # raw arrays reach the cohort UN-narrowed: the sanitizer
+            # must see the hostile 2^40 id, not its silently
+            # int32-wrapped ghost
+            src = np.asarray(req["src"])
+            dst = np.asarray(req["dst"])
+        else:
+            # disarmed: the EXACT legacy pre-cast — a python-int list
+            # with an out-of-int32 value raises here (OverflowError),
+            # it must never reach cohort.feed as an int64 array whose
+            # int32 re-cast would wrap silently into a plausible id
+            src = np.asarray(req["src"], np.int32)
+            dst = np.asarray(req["dst"], np.int32)
         with self._lock:
             accepted = self.cohort.feed(req["tenant"], src, dst)
             self._bp_attempts.pop(str(req["tenant"]), None)
-        return {"ok": True, "accepted": int(accepted)}
+            t = self.cohort.tenants.get(str(req["tenant"]))
+            rep = t.last_report if t is not None else None
+            quarantined = (t is not None
+                           and t.tier == "quarantined")
+        resp = {"ok": True, "accepted": int(accepted)}
+        if rep is not None:
+            # typed rejection surface: reason-code counts for the
+            # edges the sanitizer peeled off to the dead-letter
+            # journal ({} on a clean batch — replies stay identical)
+            resp.update(rep.wire_fields())
+        if quarantined:
+            resp["quarantined"] = True
+        return resp
 
     def _op_pump(self, req: dict) -> dict:
         results = self.pump_once()
@@ -396,11 +443,16 @@ class StreamServer:
         return out
 
     def _any_ready(self) -> bool:
+        # quarantined tenants never count as ready: their queues are
+        # suspended (probation drains them opportunistically when the
+        # healthy tenants pump), and drain() must terminate even with
+        # a still-poisoned stream's backlog queued — those edges are
+        # safe in the WAL/DLQ, not lost
         with self._lock:
             return any(t.queued >= self.cohort.eb or
                        (t.closing and t.queued)
                        for t in self.cohort.tenants.values()
-                       if not t.closed)
+                       if not t.closed and t.tier != "quarantined")
 
     # ------------------------------------------------------------------
     # file-tail sources
@@ -605,6 +657,17 @@ class StreamServer:
             "latency": latency.health_section(),
             **stats,
         }
+        with self._lock:
+            quarantined = self.cohort.quarantined()
+        if quarantined:
+            sec["quarantined"] = quarantined
+        dlq = sanitize_mod.dlq_status()
+        if dlq is not None:
+            # DLQ depth on the status surface: how many rejected
+            # records an operator has to triage (tools/dlq_report.py)
+            sec["dlq"] = dlq
+        if sanitize_mod.enabled():
+            sec["sanitize"] = sanitize_mod.mode()
         if wal is not None:
             offs = wal.offsets()
             sec["wal"] = {"tenants": len(offs),

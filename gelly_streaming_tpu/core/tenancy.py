@@ -83,6 +83,7 @@ from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
+from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
 from ..utils import wal as wal_mod
 
@@ -113,6 +114,12 @@ def pinned_tpd() -> int:
     """GS_TENANT_TPD: tenants per vmapped dispatch; 0 = auto (the
     tuner's arm, or all ready tenants with GS_AUTOTUNE=0)."""
     return knobs.get_int("GS_TENANT_TPD")
+
+
+def quarantine_windows() -> int:
+    """GS_QUARANTINE_WINDOWS: clean solo probation windows before a
+    quarantined tenant re-enters the cohort (0 = permanent)."""
+    return knobs.get_int("GS_QUARANTINE_WINDOWS")
 
 
 # ----------------------------------------------------------------------
@@ -162,6 +169,35 @@ class TenantBackpressure(TenantError):
         self.capacity = capacity
 
 
+class TenantQuarantined(TenantRejected):
+    """A feed() reached a quarantined tenant — the cohort bulkhead
+    suspended the stream after a poisoned dispatch, and admission
+    stays refused until the probation ladder re-admits it (or forever
+    with GS_QUARANTINE_WINDOWS=0). Carries `probation_left` so a
+    client can tell a permanent quarantine from a recovering one.
+    Events stamp buffered (non-durable): the quarantine itself already
+    wrote the durable record, and a hostile client retry-flooding a
+    quarantined stream must not become fsync-bound."""
+
+    def __init__(self, message: str, tenant, probation_left: int):
+        super().__init__(message, tenant, _durable=False,
+                         reason="quarantined",
+                         probation_left=probation_left)
+        self.probation_left = probation_left
+
+
+class PoisonOutput(RuntimeError):
+    """A cohort dispatch finalized implausible output (negative or
+    out-of-domain analytics) for specific slab rows. Internal signal
+    of the bulkhead: `tenants` names the poisoned stream(s), and the
+    dispatch loop quarantines exactly those and re-runs the rest —
+    never raised to callers."""
+
+    def __init__(self, message: str, tenants):
+        super().__init__(message)
+        self.tenants = list(tenants)
+
+
 class _Tenant:
     """One admitted stream: its bounded ingest queue, carried state in
     the engine-shared layout, cursors, and (after demotion) its own
@@ -170,7 +206,8 @@ class _Tenant:
     __slots__ = ("tid", "vb", "kb", "src", "dst", "carry",
                  "windows_done", "closed_partial", "closing", "closed",
                  "tier", "engine", "ckpt_policy", "dropped_edges",
-                 "bp_stamped")
+                 "bp_stamped", "fed_offset", "probation",
+                 "quarantine_reason", "last_report")
 
     def __init__(self, tid: str, vb: int, kb: int):
         self.tid = tid
@@ -184,10 +221,15 @@ class _Tenant:
         self.closed_partial = False
         self.closing = False
         self.closed = False
-        self.tier = "cohort"       # "cohort" | "single"
-        self.engine = None         # demoted StreamSummaryEngine
+        self.tier = "cohort"       # "cohort" | "single" | "quarantined"
+        self.engine = None         # demoted/probation engine
         self.ckpt_policy = None    # per-tenant CheckpointPolicy
         self.dropped_edges = 0
+        self.fed_offset = 0        # cumulative fed edges incl. rejects
+                                   # (the DLQ's source-offset domain)
+        self.probation = 0         # clean solo windows since quarantine
+        self.quarantine_reason = None
+        self.last_report = None    # last feed()'s SanitizeReport
 
     @property
     def queued(self) -> int:
@@ -293,6 +335,18 @@ class TenantCohort:
             raise TenantRejected(
                 "tenant %r is closed — its final (partial) window was "
                 "already cut" % tid, tid, reason="closed")
+        if for_feed and t.tier == "quarantined" \
+                and quarantine_windows() <= 0:
+            # permanent quarantine (GS_QUARANTINE_WINDOWS=0): refuse
+            # typed — nothing would ever drain the queue. With
+            # probation enabled, feeds stay ACCEPTED into the bounded
+            # queue (it is what the solo probation windows consume to
+            # earn re-admission); the serving front-end surfaces the
+            # quarantined flag on the feed reply instead.
+            raise TenantQuarantined(
+                "tenant %r is quarantined (%s); "
+                "GS_QUARANTINE_WINDOWS=0 — permanent for this process"
+                % (tid, t.quarantine_reason), tid, probation_left=-1)
         return t
 
     # ------------------------------------------------------------------
@@ -325,17 +379,48 @@ class TenantCohort:
                 "tenant %r already closed a partial window (length "
                 "not a multiple of edge_bucket); it cannot accept "
                 "more of the stream" % t.tid)
-        src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
-        dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
-        if len(src) != len(dst):
-            raise ValueError("src/dst length mismatch")
-        if len(src) and (int(src.max()) >= t.vb  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
-                         or int(dst.max()) >= t.vb  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
-                         or int(src.min()) < 0 or int(dst.min()) < 0):  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
-            raise ValueError(
-                "tenant %r ids must be dense in [0, %d) — out-of-range "
-                "ids would scatter into another slot's carried state"
-                % (t.tid, t.vb))
+        # "admit" fault site: chaos/tests poison the raw parsed arrays
+        # at the admission boundary — UPSTREAM of the sanitizer — the
+        # way the "parse" site tears file bytes (utils/faults)
+        got = faults.fire("admit", (t.tid, src, dst))
+        if got is not None:
+            _tid, src, dst = got
+        t.last_report = None
+        report = None
+        if sanitize_mod.enabled():
+            # armed admission: structurally invalid records peel off
+            # to the dead-letter journal (typed reason codes, absolute
+            # source offsets) and the accepted remainder — every id
+            # proven in [0, vb) — continues; the legacy hard-refusal
+            # path below stays bit-identical with GS_SANITIZE=off.
+            # commit=False: journaling + the offset advance happen
+            # only once the batch clears the capacity gate below — a
+            # backpressure-refused feed accepts NOTHING, so its
+            # retry must not double-journal the rejects.
+            try:
+                report = sanitize_mod.sanitize(
+                    src, dst, t.vb, tenant=t.tid, origin="feed",
+                    offset=t.fed_offset,
+                    dlq=sanitize_mod.resolve_dlq(), commit=False)
+            except sanitize_mod.BatchRejected as e:
+                # whole-batch refusals are terminal (never retried
+                # as-is): already journaled, the offset domain moves
+                t.fed_offset += e.size
+                raise
+            src = report.src.astype(np.int32)
+            dst = report.dst.astype(np.int32)
+        else:
+            src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+            dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+            if len(src) != len(dst):
+                raise ValueError("src/dst length mismatch")
+            if len(src) and (int(src.max()) >= t.vb  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
+                             or int(dst.max()) >= t.vb  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
+                             or int(src.min()) < 0 or int(dst.min()) < 0):  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary id check)
+                raise ValueError(
+                    "tenant %r ids must be dense in [0, %d) — "
+                    "out-of-range ids would scatter into another "
+                    "slot's carried state" % (t.tid, t.vb))
         capacity = queue_windows() * self.eb
         room = capacity - t.queued
         take = len(src)
@@ -357,6 +442,19 @@ class TenantCohort:
                             tenant=t.tid, kind="drop", shed=shed)
             metrics.counter_inc("gs_tenant_dropped_edges_total", shed,
                                 tenant=t.tid)
+        # the batch is now CONSUMED (fully, or drop-policy partially —
+        # either way the caller will not retry it as-is): journal the
+        # sanitizer's rejects and advance the source-offset domain.
+        # A backpressure-reject raised above commits nothing, so the
+        # retried batch journals its rejects exactly once.
+        if report is not None:
+            sanitize_mod.commit_report(report, tenant=t.tid,
+                                       origin="feed",
+                                       dlq=sanitize_mod.resolve_dlq())
+            t.fed_offset += report.accepted + report.rejected
+            t.last_report = report
+        else:
+            t.fed_offset += len(src)
         if take:
             if self._wal is not None:
                 # durability boundary: the accepted edges hit the
@@ -542,13 +640,40 @@ class TenantCohort:
         with telemetry.span("cohort.dispatch", tenants=len(real),
                             windows=sum(w for _t, _r, w, _n in real),
                             edges=edges):
-            faults.fire("cohort_dispatch")
+            faults.fire("cohort_dispatch",
+                        tuple(t.tid for t, _r, _w, _n in real))
             new_carries, outs = resilience.call_guarded(
                 "dispatch", ("cohort", self._round_no), _dispatch,
                 retries=0)  # carry-mutating: deadline only, never re-run
         mats = tuple(np.array(x) for x in outs)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per dispatch)
         latency.stamp(st, "dispatch")  # device wait ends with the d2h
         mdeg, ncomp, odd, tri, ovf = mats
+        # the bulkhead's output gate: BEFORE any tenant state mutates,
+        # refuse implausible analytics per slab row (negative counts,
+        # components past the bucket, non-finite values) — a poisoned
+        # carry must never be folded back, and naming the rows lets
+        # the dispatch loop quarantine exactly the poison tenants and
+        # re-run the rest of the round
+        poisoned = []
+        for t, row, w, _n in real:
+            bad = False
+            redo = np.asarray(ovf[row, :w]) != 0  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+            for arr, hi, skip in ((mdeg, None, None),
+                                  (ncomp, t.vb + 1, None),
+                                  (tri, None, redo)):
+                v = np.asarray(arr[row, :w])  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
+                if v.dtype.kind == "f" and not np.isfinite(v).all():
+                    bad = True
+                ok = np.ones(w, bool) if skip is None else ~skip
+                if (v[ok] < 0).any() \
+                        or (hi is not None and (v[ok] > hi).any()):
+                    bad = True
+            if bad:
+                poisoned.append(t.tid)
+        if poisoned:
+            raise PoisonOutput(
+                "cohort dispatch finalized implausible analytics for "
+                "tenant(s) %s" % ", ".join(poisoned), poisoned)
         for t, row, w, n in real:
             summaries = []
             for j in range(w):
@@ -596,6 +721,113 @@ class TenantCohort:
             self._stage_ckpt(t, staged)
         return edges
 
+    def _dispatch_guarded(self, vb: int, kb: int, batch, wins, slab,
+                          out: dict, staged: list) -> int:
+        """The cohort bulkhead around one dispatch batch. A dispatch
+        that fails (typed StageError from the guard, a non-fatal
+        injected fault) or finalizes implausible output is BISECTED to
+        the poison tenant(s): the offending streams are quarantined
+        (durable event, suspended feeds, solo probation — see
+        _quarantine) and the remaining tenants re-dispatch THE SAME
+        round from their untouched queues and carries, so one hostile
+        stream can never take the cohort down.
+
+        Quarantine requires DISCRIMINATING evidence — a failure that
+        follows a strict subset of the tenants. If every tenant of
+        the batch fails alone (a dead device, a wedged transfer: the
+        failure follows the hardware, not the data), the quarantines
+        are revoked and the typed error propagates exactly as it did
+        before the bulkhead existed. Fatal injected faults (the chaos
+        kill) and KeyboardInterrupt/SystemExit pass through — a kill
+        must stay a kill. Exception-free dispatches run exactly the
+        pre-bulkhead path (no re-prep, no overhead)."""
+        errors = []  # (tenant_id, err) per singleton-failure quarantine
+        edges = self._dispatch_bulkhead(vb, kb, batch, wins, slab,
+                                        out, staged, errors)
+        failed = {tid for tid, _e in errors}
+        if errors and failed == {t.tid for t in batch}:
+            for t in batch:
+                if t.tid in failed:
+                    self._unquarantine(
+                        t, "systemic dispatch failure — every tenant "
+                           "failed alone")
+            raise errors[-1][1]
+        return edges
+
+    def _dispatch_bulkhead(self, vb: int, kb: int, batch, wins, slab,
+                           out: dict, staged: list,
+                           errors: list) -> int:
+        try:
+            return self._dispatch_batch(vb, kb, slab, out, staged)
+        except PoisonOutput as e:
+            # row-attributed evidence: quarantine exactly the named
+            # tenants (no bisect, and never revoked as systemic —
+            # the verdict names rows, not the whole dispatch)
+            bad = set(e.tenants)
+            for t in batch:
+                if t.tid in bad:
+                    self._quarantine(t, "implausible dispatch output")
+            keep = [(t, w) for t, w in zip(batch, wins)
+                    if t.tid not in bad]
+        except faults.InjectedFault as e:
+            if e.fatal:
+                raise
+            keep = self._bisect_split(batch, wins, e, errors)
+            if keep is None:
+                return 0
+            # keep is (first_half, second_half): dispatch each
+            return sum(
+                self._dispatch_bulkhead(vb, kb, b, w,
+                                        self._prep_slab(b, w), out,
+                                        staged, errors)
+                for b, w in keep if b)
+        except resilience.StageError as e:
+            keep = self._bisect_split(batch, wins, e, errors)
+            if keep is None:
+                return 0
+            return sum(
+                self._dispatch_bulkhead(vb, kb, b, w,
+                                        self._prep_slab(b, w), out,
+                                        staged, errors)
+                for b, w in keep if b)
+        # PoisonOutput path: re-run the named-healthy remainder once
+        if not keep:
+            return 0
+        b = [t for t, _w in keep]
+        w = [x for _t, x in keep]
+        return self._dispatch_bulkhead(vb, kb, b, w,
+                                       self._prep_slab(b, w), out,
+                                       staged, errors)
+
+    def _bisect_split(self, batch, wins, err, errors: list):
+        """Halve a failing batch for fault attribution; a singleton
+        failing batch IS the implicated tenant — quarantine it,
+        record the evidence for the systemic-failure check, and stop
+        (returns None)."""
+        if len(batch) == 1:
+            self._quarantine(batch[0], "poison dispatch: %s: %s"
+                             % (type(err).__name__, err))
+            errors.append((batch[0].tid, err))
+            return None
+        mid = len(batch) // 2
+        telemetry.event("cohort_bisect", tenants=len(batch),
+                        error=type(err).__name__)
+        metrics.counter_inc("gs_cohort_bisects_total")
+        return ((batch[:mid], wins[:mid]), (batch[mid:], wins[mid:]))
+
+    def _unquarantine(self, t: _Tenant, reason: str) -> None:
+        """Revoke a quarantine this round imposed without
+        discriminating evidence (the systemic-failure path)."""
+        if t.tier != "quarantined":
+            return
+        t.tier = "cohort"
+        t.engine = None
+        t.probation = 0
+        t.quarantine_reason = None
+        telemetry.event("quarantine_revoked", durable=True,
+                        tenant=t.tid, reason=reason)
+        metrics.gauge_set("gs_tenant_quarantined", 0, tenant=t.tid)
+
     def pump(self, max_rounds: Optional[int] = None,
              only: Optional[str] = None) -> Dict[str, list]:
         """Dispatch window cohorts while any tenant has a full window
@@ -612,6 +844,7 @@ class TenantCohort:
         rounds = 0
         while max_rounds is None or rounds < max_rounds:
             self._pump_singles(out, staged, only=only)
+            probed = self._pump_probation(out, staged, only=only)
             by_group: Dict[tuple, list] = {}
             for tid in sorted(self.tenants):
                 if only is not None and tid != only:
@@ -620,6 +853,13 @@ class TenantCohort:
                 if self._take_windows(t) > 0:
                     by_group.setdefault((t.vb, t.kb), []).append(t)
             if not by_group:
+                if probed:
+                    # probation made progress (a quarantined tenant
+                    # finalized clean solo windows, possibly
+                    # re-entering the cohort) — keep pumping; failing
+                    # probes return 0, so this can never spin
+                    rounds += 1
+                    continue
                 break
             rounds += 1
             self._round_no += 1
@@ -658,9 +898,10 @@ class TenantCohort:
         if len(descs) == 1:
             # one batch: a worker-pool round trip buys nothing — build
             # the slab inline (the serving-shape hot path)
-            return self._dispatch_batch(vb, kb,
-                                        self._prep_slab(*descs[0]),
-                                        out, staged)
+            batch, wins = descs[0]
+            return self._dispatch_guarded(vb, kb, batch, wins,
+                                          self._prep_slab(batch, wins),
+                                          out, staged)
         pending = {}
         try:
             for i, (batch, wins) in enumerate(descs):
@@ -683,8 +924,9 @@ class TenantCohort:
                     slab = fut.result()
                 else:
                     slab = self._prep_slab(*descs[i])
-                edges += self._dispatch_batch(vb, kb, slab, out,
-                                              staged)
+                edges += self._dispatch_guarded(
+                    vb, kb, descs[i][0], descs[i][1], slab, out,
+                    staged)
         except BaseException:
             # a mid-round failure (stage timeout, fatal injected kill)
             # must not strand prepped slabs in the ring — the NEXT
@@ -734,6 +976,108 @@ class TenantCohort:
                                 tier="single")
             self._stage_ckpt(t, staged)
 
+    def _pump_probation(self, out: dict, staged: list,
+                        only: Optional[str] = None) -> int:
+        """Quarantined tenants' probation ladder: with
+        GS_QUARANTINE_WINDOWS > 0, each pump gives every quarantined
+        tenant with a full window queued ONE solo window through an
+        isolated single-tenant engine (seeded from its last-good
+        carry). A clean window advances probation (its exact summaries
+        are delivered — isolation, not deletion); a failing or
+        implausible one resets probation and discards the probe engine
+        (the next probe re-seeds from the untouched last-good carry —
+        the failed fold never sticks). After GS_QUARANTINE_WINDOWS
+        consecutive clean windows the tenant re-enters the cohort
+        tier. Returns windows finalized (0 on every-probe-failed, so
+        pump()'s loop can never spin on a still-poisoned stream)."""
+        qw = quarantine_windows()
+        if qw <= 0:
+            return 0  # permanent quarantine: truly suspended
+        done = 0
+        for tid in sorted(self.tenants):
+            if only is not None and tid != only:
+                continue
+            t = self.tenants[tid]
+            if t.tier != "quarantined" or t.closed:
+                continue
+            n = (self.eb if t.queued >= self.eb
+                 else (t.queued if t.closing else 0))
+            if n == 0:
+                if t.closing:
+                    t.closed = True
+                continue
+            if t.engine is None:
+                eng = scan_analytics.StreamSummaryEngine(
+                    edge_bucket=self.eb, vertex_bucket=t.vb,
+                    k_bucket=t.kb)
+                eng.load_state_dict(self.tenant_state_dict(t.tid))
+                eng._lat_lane = t.tid
+                eng._lat_admit = False
+                t.engine = eng
+            t.engine._lat_defer = self.defer_delivery
+            src, dst = t.src[:n], t.dst[:n]
+            try:
+                with telemetry.span("tenant.probation", tenant=t.tid,
+                                    edges=int(n)):
+                    summaries = t.engine.process(src, dst)
+                if any(s["max_degree"] < 0 or s["num_components"] < 0
+                       or s["num_components"] > t.vb + 1
+                       or s["triangles"] < 0 for s in summaries):
+                    raise PoisonOutput(
+                        "probation window finalized implausible "
+                        "analytics", [t.tid])
+            except (KeyboardInterrupt, SystemExit):
+                raise
+            except faults.InjectedFault as e:
+                if e.fatal:
+                    raise
+                self._probation_failed(t, e)
+                continue
+            except Exception as e:  # gslint: disable=except-hygiene (captured per probe: _probation_failed stamps the event and resets the ladder; the cohort keeps serving)
+                self._probation_failed(t, e)
+                continue
+            # absorb the probe engine's state as the new last-good
+            # carry (bit-exact: the engine layout IS the cohort's)
+            est = t.engine.state_dict()
+            t.carry = tuple(jnp.asarray(a) for a in est["carry"])
+            t.src = t.src[n:]
+            t.dst = t.dst[n:]
+            t.bp_stamped = False
+            t.windows_done = t.engine.windows_done
+            t.closed_partial = t.engine._closed_partial
+            if t.closing and t.queued == 0:
+                t.closed = True
+            t.probation += len(summaries)
+            done += len(summaries)
+            out.setdefault(t.tid, []).extend(summaries)
+            metrics.mark_tenant(t.tid, len(summaries), int(n),
+                                tier="quarantined")
+            telemetry.event("quarantine_probe", tenant=t.tid,
+                            clean=t.probation, required=qw)
+            self._stage_ckpt(t, staged)
+            if t.probation >= qw:
+                t.tier = "cohort"
+                t.engine = None
+                t.quarantine_reason = None
+                t.probation = 0
+                telemetry.event("quarantine_released", durable=True,
+                                tenant=t.tid,
+                                windows_done=t.windows_done)
+                metrics.counter_inc(
+                    "gs_tenant_quarantine_releases_total")
+                metrics.gauge_set("gs_tenant_quarantined", 0,
+                                  tenant=t.tid)
+        return done
+
+    def _probation_failed(self, t: _Tenant, err) -> None:
+        t.probation = 0
+        t.engine = None  # re-seed from the last-good carry next probe
+        telemetry.event("quarantine_probe_failed", durable=True,
+                        tenant=t.tid,
+                        error="%s: %s" % (type(err).__name__,
+                                          str(err)[:200]))
+        metrics.counter_inc("gs_tenant_probation_failures_total")
+
     def close(self, tenant_id) -> List[dict]:
         """Cut the tenant's final (possibly partial) window and retire
         it. Drains ONLY this tenant (pump(only=...)) — other tenants'
@@ -748,6 +1092,42 @@ class TenantCohort:
             return []
         out = self.pump(only=t.tid)
         return out.get(t.tid, [])
+
+    # ------------------------------------------------------------------
+    # quarantine (the bulkhead's suspended state)
+    # ------------------------------------------------------------------
+    def _quarantine(self, t: _Tenant, reason: str) -> None:
+        """Suspend one poison stream: no cohort dispatches, feeds
+        refused with typed TenantQuarantined, queued edges kept for
+        the probation ladder (or the operator's DLQ triage). The
+        durable `quarantine` event + demotion record are the
+        post-mortem evidence; per-tenant /healthz + metrics rows ride
+        the existing cardinality-bounded tenant labels."""
+        if t.tier == "quarantined":
+            return
+        from_tier = t.tier
+        t.tier = "quarantined"
+        t.engine = None
+        t.probation = 0
+        t.quarantine_reason = str(reason)[:200]
+        telemetry.event("quarantine", durable=True, tenant=t.tid,
+                        reason=t.quarantine_reason,
+                        windows_done=t.windows_done)
+        metrics.counter_inc("gs_tenant_quarantines_total")
+        metrics.gauge_set("gs_tenant_quarantined", 1, tenant=t.tid)
+        resilience.record_demotion(
+            "tenant:%s" % t.tid, from_tier, "quarantined",
+            t.windows_done, t.quarantine_reason, tenant=t.tid)
+
+    def quarantine(self, tenant_id, reason: str = "operator") -> None:
+        """Operator hook: suspend one tenant by hand (the same state a
+        poisoned dispatch lands in)."""
+        self._quarantine(self._tenant(tenant_id), reason)
+
+    def quarantined(self) -> List[str]:
+        """Currently quarantined tenant ids (the /healthz cell)."""
+        return [tid for tid in sorted(self.tenants)
+                if self.tenants[tid].tier == "quarantined"]
 
     # ------------------------------------------------------------------
     # demotion (cohort → single-tenant engine)
@@ -784,23 +1164,36 @@ class TenantCohort:
         checkpoint restores into a single-tenant StreamSummaryEngine
         (the demotion ladder) and vice versa at equal buckets."""
         t = self._tenant(tenant_id)
-        if t.tier == "single":
-            return t.engine.state_dict()
-        carry = (t.carry if t.carry is not None
-                 else self._fresh_carry(t.vb))
-        deg, labels, cover = (np.array(x) for x in carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: the tenant state_dict's one d2h)
-        return {
-            "edge_bucket": self.eb,
-            "vertex_bucket": t.vb,
-            "windows_done": int(t.windows_done),
-            "closed_partial": bool(t.closed_partial),
-            # the journal offset at this finalized-window boundary
-            # (cumulative edges folded into the carry): recover()
-            # replays the WAL strictly past it — the offset/checkpoint
-            # contract of DESIGN.md §18
-            "wal_offset": int(t.windows_done) * self.eb,
-            "carry": (deg, labels, cover),
-        }
+        if t.tier == "single" or (t.tier == "quarantined"
+                                  and t.engine is not None):
+            state = t.engine.state_dict()
+        else:
+            carry = (t.carry if t.carry is not None
+                     else self._fresh_carry(t.vb))
+            deg, labels, cover = (np.array(x) for x in carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: the tenant state_dict's one d2h)
+            state = {
+                "edge_bucket": self.eb,
+                "vertex_bucket": t.vb,
+                "windows_done": int(t.windows_done),
+                "closed_partial": bool(t.closed_partial),
+                # the journal offset at this finalized-window boundary
+                # (cumulative edges folded into the carry): recover()
+                # replays the WAL strictly past it — the
+                # offset/checkpoint contract of DESIGN.md §18
+                "wal_offset": int(t.windows_done) * self.eb,
+                "carry": (deg, labels, cover),
+            }
+        if t.tier == "quarantined":
+            # the bulkhead state rides the checkpoint (an engine
+            # restoring this layout ignores the extra key): a killed
+            # cohort must come back still-quarantined with its
+            # probation progress, never silently re-admitting a
+            # poison stream
+            state["quarantine"] = {
+                "probation": int(t.probation),
+                "reason": t.quarantine_reason or "",
+            }
+        return state
 
     def load_tenant_state_dict(self, tenant_id, state: dict) -> None:
         t = self._tenant(tenant_id)
@@ -822,6 +1215,23 @@ class TenantCohort:
                 "coverage (%d windows x eb=%d)" % (
                     int(woff), t.windows_done, self.eb))
         t.carry = tuple(jnp.asarray(a) for a in state["carry"])
+        q = state.get("quarantine")
+        if q is not None:
+            t.tier = "quarantined"
+            t.engine = None  # probes re-seed from the restored carry
+            t.probation = int(q.get("probation", 0))  # gslint: disable=host-sync (checkpoint payloads are host scalars, never device values)
+            t.quarantine_reason = q.get("reason") or "restored"
+            metrics.gauge_set("gs_tenant_quarantined", 1,
+                              tenant=t.tid)
+        elif t.tier == "quarantined":
+            # the checkpoint is authoritative: restoring a generation
+            # taken before the quarantine rewinds the bulkhead too
+            t.tier = "cohort"
+            t.engine = None
+            t.probation = 0
+            t.quarantine_reason = None
+            metrics.gauge_set("gs_tenant_quarantined", 0,
+                              tenant=t.tid)
         if t.tier == "single":
             t.engine.load_state_dict(state)
 

@@ -46,6 +46,7 @@ from ..utils import checkpoint
 from ..utils import faults
 from ..utils import latency
 from ..utils import metrics
+from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
 from ..utils import wal as wal_mod
 
@@ -197,6 +198,9 @@ class SummaryEngineBase:
         # stranded by a mid-call failure must never join a later
         # run's window at the same chunk offset.
         self._lat_stamps = {}
+        # cumulative fed edges incl. sanitizer rejects — the DLQ's
+        # source-offset domain for this engine's admission boundary
+        self._fed_edges = 0
         if not hasattr(self, "_wal"):
             # write-ahead journal config survives reset() too
             self._wal = None
@@ -450,6 +454,26 @@ class SummaryEngineBase:
         lat = latency.enabled()
         t_admit = latency.clock() if lat else 0.0
         metrics.on_stream_start(type(self).__name__)
+        # "admit" fault site + armed sanitizer: the engine's admission
+        # boundary mirrors the cohort's feed() — garbage ids peel off
+        # to the dead-letter journal BEFORE the journal/fold see them;
+        # GS_SANITIZE=off (default) skips straight to the legacy path
+        got = faults.fire("admit", (self._wal_tenant, src, dst))
+        if got is not None:
+            _t, src, dst = got
+        if sanitize_mod.enabled():
+            try:
+                rep = sanitize_mod.sanitize(
+                    src, dst, self.vb, tenant=self._wal_tenant,
+                    origin="engine", offset=self._fed_edges,
+                    dlq=sanitize_mod.resolve_dlq())
+            except sanitize_mod.BatchRejected as e:
+                self._fed_edges += e.size
+                raise
+            self._fed_edges += rep.accepted + rep.rejected
+            src, dst = rep.src, rep.dst
+        else:
+            self._fed_edges += len(np.atleast_1d(np.asarray(src)))  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
         n = len(src)

@@ -39,6 +39,15 @@ dict lookup on the hot path):
     ckpt_restore  utils/checkpoint.restore (before the load)
     parse         io/sources edge-chunk parse (payload=bytes;
                   corrupt_bytes garbles one line)
+    admit         every admission boundary — TenantCohort.feed,
+                  SummaryEngineBase.process, driver.run_arrays —
+                  BEFORE the sanitizer (utils/sanitize) and the
+                  journal see the batch; payload=(tenant, src, dst),
+                  so a `call` spec can poison the parsed arrays the
+                  way corrupt_bytes tears file bytes (chaos targets
+                  the sanitizer through exactly this hook)
+    wal_enqueue   between the journal append and the queue/fold (the
+                  kill window the WAL contract pins)
 
 Mesh-scoped sites (fired only by the sharded engines and the driver's
 mesh path — parallel/sharded.py; a single-chip run never fires them,

@@ -464,6 +464,41 @@ register("GS_SLO_BURN", "float", 2.0, lo=0.1,
               "durable `slo_burn` event (once per episode; recovery "
               "stamps `slo_recovered`)")
 
+# admission sanitizer, dead-letter journal & tenant bulkheads
+# (utils/sanitize.py + core/tenancy.py)
+register("GS_SANITIZE", "str", "off", choices=("off", "on", "strict"),
+         help="admission sanitizer (`utils/sanitize.py`) run at every "
+              "ingest boundary BEFORE the journal: `off` (default) is "
+              "bit-identical to a pre-sanitizer build, `on` rejects "
+              "structurally invalid records (out-of-range / negative "
+              "/ int32-overflowing / non-integer ids) with typed "
+              "reason codes, `strict` adds the self-loop and "
+              "duplicate-flood policies",
+         default_text="off")
+register("GS_DLQ_DIR", "path", None,
+         help="dead-letter journal directory: rejected admission "
+              "records are appended as CRC-framed segment records "
+              "(origin tenant + source offset + reason + the edges) "
+              "for `tools/dlq_report.py` to render and re-inject; "
+              "unset/`0` = rejections are counted and dropped",
+         default_text="unset")
+register("GS_DLQ_RETAIN", "int", 0, lo=0,
+         help="closed dead-letter segments kept after rotation "
+              "(rotation size is GS_WAL_SEGMENT_BYTES); 0 (default) "
+              "keeps every segment",
+         default_text="0 (keep all)")
+register("GS_QUARANTINE_WINDOWS", "int", 4, lo=0,
+         help="clean solo probation windows a quarantined tenant "
+              "must finalize before the cohort re-admits it to the "
+              "shared vmapped dispatch (`core/tenancy.py` bulkhead); "
+              "0 = quarantine is permanent for the process")
+register("GS_MAX_BATCH_EDGES", "int", 0, lo=0,
+         help="admission batch-size bound: a single feed()/process() "
+              "batch longer than this is refused whole with a typed "
+              "`BatchRejected` (and journaled to the DLQ when armed); "
+              "0 (default) = unbounded",
+         default_text="0 (unbounded)")
+
 # program cost observatory (utils/costmodel.py)
 register("GS_COSTMODEL", "bool", False,
          help="arm the program cost observatory "

@@ -32,6 +32,8 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_SERVE_PORT GS_SERVE_DRAIN_S GS_SERVE_IDLE_S "
        "GS_LATENCY GS_LAT_MARKS GS_LAT_PENDING "
        "GS_SLO_P99_S GS_SLO_BUDGET GS_SLO_WINDOW_S GS_SLO_BURN "
+       "GS_SANITIZE GS_DLQ_DIR GS_DLQ_RETAIN "
+       "GS_QUARANTINE_WINDOWS GS_MAX_BATCH_EDGES "
        "GS_COSTMODEL GS_COSTMODEL_PEAK_GFLOPS "
        "GS_COSTMODEL_PEAK_GBPS").split()
 

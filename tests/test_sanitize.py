@@ -1,0 +1,693 @@
+"""Admission sanitizer + dead-letter journal + cohort bulkhead
+(utils/sanitize.py, core/tenancy.py quarantine, ISSUE 15).
+
+Covers: the vectorized validator vs a pure-Python policy oracle
+(including a fuzz loop through native.parse_edge_bytes — random byte
+soup must never crash an admission boundary and must split exactly as
+the oracle says), the DLQ's framing/rotation/retention/torn-tail
+discipline, the knobs-off bit-identity contract, the bulkhead's
+bisect→quarantine→probation ladder with its checkpoint round-trip,
+and the serving front-end's typed rejection surface."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.tenancy import (
+    TenantCohort, TenantQuarantined)
+from gelly_streaming_tpu.ops.scan_analytics import StreamSummaryEngine
+from gelly_streaming_tpu.utils import faults, sanitize
+
+EB, VB = 64, 128
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    sanitize.reset()
+    yield
+    sanitize.reset()
+
+
+def _arm(monkeypatch, tmp_path, mode="on", dlq=True):
+    monkeypatch.setenv("GS_SANITIZE", mode)
+    if dlq:
+        monkeypatch.setenv("GS_DLQ_DIR", str(tmp_path / "dlq"))
+    return str(tmp_path / "dlq")
+
+
+def oracle_split(src, dst, vb, mode):
+    """Pure-Python twin of sanitize()'s per-edge policy: returns
+    (keep_mask, reason_per_edge). Mirrors the documented severity
+    order and the DUP_FLOOD_KEEP constant."""
+    n = len(src)
+    reasons = [None] * n
+    seen = {}
+    for i in range(n):
+        s, d = src[i], dst[i]
+
+        def intish(x):
+            try:
+                if isinstance(x, float):
+                    return x == int(x)  # finite & integral
+                int(x)
+                return True
+            except (ValueError, OverflowError, TypeError):
+                return False
+
+        if not (intish(s) and intish(d)):
+            reasons[i] = "non_integer"
+            continue
+        s, d = int(s), int(d)
+        if vb is not None:
+            if s < 0 or d < 0:
+                reasons[i] = "id_negative"
+                continue
+            if s >= 2 ** 31 or d >= 2 ** 31:
+                reasons[i] = "id_overflow"
+                continue
+            if s >= vb or d >= vb:
+                reasons[i] = "id_out_of_range"
+                continue
+        if mode == "strict":
+            if s == d:
+                reasons[i] = "self_loop"
+                continue
+            k = (s, d)
+            seen[k] = seen.get(k, 0) + 1
+            if seen[k] > sanitize.DUP_FLOOD_KEEP:
+                reasons[i] = "duplicate_flood"
+    keep = np.array([r is None for r in reasons], bool)
+    return keep, reasons
+
+
+# ----------------------------------------------------------------------
+# the validator vs the oracle
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("mode", ["on", "strict"])
+def test_adversarial_batch_matches_oracle(monkeypatch, tmp_path,
+                                          mode):
+    _arm(monkeypatch, tmp_path, mode=mode, dlq=False)
+    src = [1, -5, 2 ** 40, 130, 3, 3, float("nan"), float("inf"),
+           2.5, 7] + [9] * 12
+    dst = [2, 1, 1, 1, 3, 4, 1.0, 2.0, 1.0, 8] + [11] * 12
+    keep, reasons = oracle_split(src, dst, VB, mode)
+    rep = sanitize.sanitize(np.array(src), np.array(dst), VB)
+    assert np.array_equal(rep.keep, keep)
+    want = {}
+    for r in reasons:
+        if r is not None:
+            want[r] = want.get(r, 0) + 1
+    assert rep.reasons == want
+    assert rep.accepted + rep.rejected == len(src)
+    # accepted values survive in order
+    assert rep.src.tolist() == [int(s) for s, k
+                                in zip(src, keep) if k]
+
+
+def test_fuzz_parse_bytes_never_crashes_and_matches_oracle(
+        monkeypatch, tmp_path):
+    """Random byte soup through native.parse_edge_bytes → sanitizer:
+    no admission boundary may crash, and the accepted split must
+    equal the pure-Python oracle exactly (the fuzz contract)."""
+    from gelly_streaming_tpu import native
+
+    _arm(monkeypatch, tmp_path, mode="strict", dlq=False)
+    rng = np.random.default_rng(1234)
+    for it in range(25):
+        raw = bytes(rng.integers(0, 256, 512, dtype=np.uint8))
+        if it % 2:
+            # half the iterations: parseable lines with garbage ids
+            raw += b"\n" + b"\n".join(
+                b"%d %d" % (rng.integers(-(1 << 40), 1 << 40),
+                            rng.integers(-(1 << 40), 1 << 40))
+                for _ in range(32))
+        src, dst, _ts = native.parse_edge_bytes(raw)
+        rep = sanitize.sanitize(src, dst, VB)
+        keep, _reasons = oracle_split(src.tolist(), dst.tolist(),
+                                      VB, "strict")
+        assert np.array_equal(rep.keep, keep), raw[:80]
+        assert (rep.src < VB).all() and (rep.src >= 0).all()
+        assert (rep.dst < VB).all() and (rep.dst >= 0).all()
+
+
+def test_driver_domain_vb_none(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path, dlq=False)
+    rep = sanitize.sanitize(
+        np.array([1.0, 2 ** 40, float("nan"), -7, 3.5]),
+        np.array([2.0, 5, 1.0, 8, 9]), None)
+    # huge and negative EXTERNAL ids are legal (the interner's
+    # domain); NaN and fractional ids are not
+    assert rep.src.tolist() == [1, 2 ** 40, -7]
+    assert rep.reasons == {"non_integer": 2}
+
+
+def test_off_mode_is_inert(monkeypatch):
+    monkeypatch.setenv("GS_SANITIZE", "")
+    assert not sanitize.enabled()
+    monkeypatch.setenv("GS_SANITIZE", "off")
+    assert not sanitize.enabled()
+    assert sanitize.resolve_dlq() is None
+
+
+def test_batch_overflow_typed_and_journaled(monkeypatch, tmp_path):
+    dlq_dir = _arm(monkeypatch, tmp_path)
+    monkeypatch.setenv("GS_MAX_BATCH_EDGES", "8")
+    with pytest.raises(sanitize.BatchRejected) as ei:
+        sanitize.sanitize(np.arange(9), np.arange(9), VB,
+                          tenant="t", origin="feed",
+                          dlq=sanitize.resolve_dlq())
+    assert ei.value.reason == "batch_overflow"
+    assert ei.value.limit == 8 and ei.value.size == 9
+    info = sanitize.scan(dlq_dir)
+    assert info["edges"] == 9
+    assert info["by_reason"] == {"batch_overflow": 9}
+
+
+def test_length_mismatch_typed():
+    with pytest.raises(sanitize.BatchRejected) as ei:
+        sanitize.sanitize(np.arange(3), np.arange(4), VB)
+    assert ei.value.reason == "length_mismatch"
+
+
+# ----------------------------------------------------------------------
+# the dead-letter journal
+# ----------------------------------------------------------------------
+def test_dlq_roundtrip_fields(tmp_path):
+    j = sanitize.DeadLetterJournal(str(tmp_path))
+    j.append("t1", "feed", "id_out_of_range",
+             np.array([5, 9]), np.array([200, 300]),
+             np.array([1, 2]))
+    j.append("t2", "engine", "id_negative",
+             np.array([0]), np.array([-4]), np.array([7]))
+    j.close()
+    recs = list(sanitize.replay(str(tmp_path)))
+    assert [(r["tenant"], r["origin"], r["reason"]) for r in recs] \
+        == [("t1", "feed", "id_out_of_range"),
+            ("t2", "engine", "id_negative")]
+    assert recs[0]["offsets"].tolist() == [5, 9]
+    assert recs[0]["src"].tolist() == [200, 300]
+    assert recs[1]["src"].tolist() == [-4]
+    info = sanitize.scan(str(tmp_path))
+    assert info["records"] == 2 and info["edges"] == 3
+    assert info["by_tenant"] == {"t1": 2, "t2": 1}
+
+
+def test_dlq_rotation_and_retention(monkeypatch, tmp_path):
+    monkeypatch.setenv("GS_WAL_SEGMENT_BYTES", "4096")
+    j = sanitize.DeadLetterJournal(str(tmp_path))
+    big = np.arange(400, dtype=np.int64)
+    for _ in range(6):
+        j.append("t", "feed", "id_out_of_range", big, big, big)
+    segs = sorted(p for p in os.listdir(str(tmp_path))
+                  if p.endswith(".seg"))
+    assert len(segs) > 2  # rotation happened
+    # retention: re-rotate with the bound armed → prefix pruned, and
+    # replay still yields only intact records (no crash on the gap)
+    monkeypatch.setenv("GS_DLQ_RETAIN", "1")
+    for _ in range(3):
+        j.append("t", "feed", "id_out_of_range", big, big, big)
+    j.close()
+    segs2 = sorted(p for p in os.listdir(str(tmp_path))
+                   if p.endswith(".seg"))
+    assert len(segs2) <= 3
+    assert list(sanitize.replay(str(tmp_path)))  # readable remainder
+
+
+def test_dlq_torn_tail_tolerated(tmp_path):
+    j = sanitize.DeadLetterJournal(str(tmp_path))
+    for i in range(3):
+        j.append("t", "feed", "id_negative",
+                 np.array([i]), np.array([-i]), np.array([i]))
+    j.close()
+    seg = sorted(tmp_path.glob("dlq_*.seg"))[-1]
+    data = seg.read_bytes()
+    seg.write_bytes(data[:-7])  # tear the last record
+    recs = list(sanitize.replay(str(tmp_path)))
+    assert len(recs) == 2  # the torn one drops, the rest replay
+
+
+def test_resolve_dlq_registry(monkeypatch, tmp_path):
+    dlq_dir = _arm(monkeypatch, tmp_path)
+    a = sanitize.resolve_dlq()
+    b = sanitize.resolve_dlq()
+    assert a is b and a.dir == dlq_dir
+    assert sanitize.dlq_status()["records"] == 0
+    a.append("t", "feed", "self_loop", np.array([0]),
+             np.array([1]), np.array([1]))
+    assert sanitize.dlq_status()["records"] == 1
+
+
+# ----------------------------------------------------------------------
+# admission boundaries
+# ----------------------------------------------------------------------
+def test_feed_armed_rejects_to_dlq_and_accepts_rest(monkeypatch,
+                                                    tmp_path):
+    dlq_dir = _arm(monkeypatch, tmp_path)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    src = np.array([1, 500, 2, -3, 3], np.int64)
+    dst = np.array([2, 1, 3, 4, 4], np.int64)
+    take = co.feed("t", src, dst)
+    assert take == 3
+    rep = co.tenants["t"].last_report
+    assert rep.reasons == {"id_negative": 1, "id_out_of_range": 1}
+    recs = list(sanitize.replay(dlq_dir))
+    assert {r["reason"] for r in recs} \
+        == {"id_negative", "id_out_of_range"}
+    # absolute source offsets: positions 1 and 3 of the first batch
+    offs = sorted(int(o) for r in recs for o in r["offsets"])
+    assert offs == [1, 3]
+    # second batch continues the offset domain
+    co.feed("t", np.array([999]), np.array([0]))
+    offs2 = [int(o) for r in sanitize.replay(dlq_dir)
+             for o in r["offsets"]]
+    assert max(offs2) == 5  # position 0 of batch 2 = offset 5
+
+
+def test_feed_disarmed_is_legacy(monkeypatch):
+    monkeypatch.delenv("GS_SANITIZE", raising=False)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    with pytest.raises(ValueError, match="dense"):
+        co.feed("t", np.array([500]), np.array([1]))
+
+
+def test_armed_clean_stream_digest_parity(monkeypatch, tmp_path):
+    """GS_SANITIZE=on with a clean stream is bit-identical to the
+    disarmed path (the evidence-gate discipline)."""
+    rng = np.random.default_rng(3)
+    s = rng.integers(0, VB, 4 * EB).astype(np.int32)
+    d = rng.integers(0, VB, 4 * EB).astype(np.int32)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    co.feed("t", s, d)
+    want = co.pump()["t"]
+    _arm(monkeypatch, tmp_path)
+    co2 = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co2.admit("t")
+    co2.feed("t", s, d)
+    assert co2.pump()["t"] == want
+    assert sanitize.scan(str(tmp_path / "dlq"))["records"] == 0
+
+
+def test_engine_process_armed_matches_filtered_oracle(monkeypatch,
+                                                      tmp_path):
+    _arm(monkeypatch, tmp_path, dlq=False)
+    rng = np.random.default_rng(5)
+    s = rng.integers(-8, 80, 4 * 8).astype(np.int64)
+    d = rng.integers(0, 64, 4 * 8).astype(np.int64)
+    eng = StreamSummaryEngine(edge_bucket=8, vertex_bucket=64)
+    eng.reset()
+    got = eng.process(s, d)
+    keep = (s >= 0) & (s < 64) & (d >= 0) & (d < 64)
+    monkeypatch.setenv("GS_SANITIZE", "off")
+    eng2 = StreamSummaryEngine(edge_bucket=8, vertex_bucket=64)
+    eng2.reset()
+    assert got == eng2.process(s[keep], d[keep])
+
+
+@pytest.mark.faults
+def test_admit_fault_site_poisons_upstream_of_sanitizer(monkeypatch,
+                                                        tmp_path):
+    dlq_dir = _arm(monkeypatch, tmp_path)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+
+    def garble(payload):
+        tid, src, dst = payload
+        src = np.asarray(src).copy()
+        src[0] = 10 ** 9  # out of range
+        return tid, src, dst
+
+    with faults.inject(faults.FaultSpec(site="admit", action="call",
+                                        fn=garble)):
+        take = co.feed("t", np.array([1, 2]), np.array([2, 3]))
+    assert take == 1
+    assert sanitize.scan(dlq_dir)["by_reason"] \
+        == {"id_out_of_range": 1}
+
+
+# ----------------------------------------------------------------------
+# the bulkhead: bisect → quarantine → probation
+# ----------------------------------------------------------------------
+def _streams(n, windows, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "t%d" % i: (rng.integers(0, VB, windows * EB).astype(np.int32),
+                    rng.integers(0, VB, windows * EB).astype(np.int32))
+        for i in range(n)}
+
+
+def _oracle(streams):
+    out = {}
+    for tid, (s, d) in streams.items():
+        eng = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+        eng.reset()
+        out[tid] = eng.process(s, d)
+    return out
+
+
+def _poison_plan(hostile):
+    def poison(payload):
+        if payload and hostile in payload:
+            raise faults.InjectedFault("poisoned", "cohort_dispatch")
+        return payload
+
+    return faults.FaultSpec(site="cohort_dispatch", action="call",
+                            fn=poison, times=10 ** 6)
+
+
+@pytest.mark.faults
+def test_bisect_isolates_exactly_the_poison_tenant():
+    streams = _streams(8, 1, seed=11)
+    oracle = _oracle(streams)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    for tid in streams:
+        co.admit(tid)
+    with faults.inject(_poison_plan("t5")):
+        for tid, (s, d) in streams.items():
+            co.feed(tid, s, d)
+        out = co.pump()
+    assert co.quarantined() == ["t5"]
+    for tid in streams:
+        if tid != "t5":
+            assert out[tid] == oracle[tid], tid
+
+
+@pytest.mark.faults
+def test_poison_output_quarantines_by_row():
+    """Implausible finalized analytics (negative counts) quarantine
+    exactly the offending slab row — no bisect needed."""
+    streams = _streams(3, 1, seed=12)
+    oracle = _oracle(streams)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    for tid in streams:
+        co.admit(tid)
+    for tid, (s, d) in streams.items():
+        co.feed(tid, s, d)
+    real_batch = TenantCohort._dispatch_batch
+
+    def evil(self, vb, kb, slab, out, staged):
+        nb, wb, s, d, valid, real, failed, st = slab
+        orig = TenantCohort._program.__get__(self)
+
+        def poisoned_program(stacked, sj, dj, vj):
+            run = orig(vb, kb, nb, wb)
+            carries, outs = run(stacked, sj, dj, vj)
+            mdeg, rest = outs[0], outs[1:]
+            rows = [r for t, r, _w, _n in real if t.tid == "t1"]
+            if rows:  # no-op once t1 is quarantined out of the batch
+                mdeg = mdeg.at[rows[0]].set(-1)
+            return carries, (mdeg,) + rest
+
+        self._program = lambda *a: poisoned_program
+        try:
+            return real_batch(self, vb, kb, slab, out, staged)
+        finally:
+            del self._program
+
+    import unittest.mock as mock
+
+    with mock.patch.object(TenantCohort, "_dispatch_batch", evil):
+        out1 = co.pump()
+    # t1 quarantined; the re-run of the remaining rows happened under
+    # the same (patched) dispatch, so pump again unpatched for the
+    # healthy remainder that was deferred
+    assert co.quarantined() == ["t1"]
+    out2 = co.pump()
+    got = {k: out1.get(k, []) + out2.get(k, [])
+           for k in streams}
+    for tid in ("t0", "t2"):
+        assert got[tid] == oracle[tid], tid
+
+
+@pytest.mark.faults
+def test_probation_readmits_after_clean_windows(monkeypatch):
+    monkeypatch.setenv("GS_QUARANTINE_WINDOWS", "2")
+    streams = _streams(2, 4, seed=13)
+    oracle = _oracle(streams)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    for tid in streams:
+        co.admit(tid)
+    with faults.inject(_poison_plan("t1")) as plan:
+        for tid, (s, d) in streams.items():
+            co.feed(tid, s[:EB], d[:EB])
+        out = co.pump()
+    assert co.quarantined() == ["t1"]
+    # quarantined feeds stay ACCEPTED (probation needs data)
+    got = {k: list(v) for k, v in out.items()}
+    for w in range(1, 4):
+        for tid, (s, d) in streams.items():
+            co.feed(tid, s[w * EB:(w + 1) * EB],
+                    d[w * EB:(w + 1) * EB])
+        for k, v in co.pump().items():
+            got.setdefault(k, []).extend(v)
+    for _ in range(4):
+        for k, v in co.pump().items():
+            got.setdefault(k, []).extend(v)
+    assert co.tenant_tier("t1") == "cohort"  # re-admitted
+    for tid in streams:
+        assert got[tid] == oracle[tid], tid
+
+
+@pytest.mark.faults
+def test_systemic_failure_revokes_quarantines_and_raises():
+    """A failure that follows EVERY tenant (dead device, wedged
+    transfer) is not poison: the bulkhead must revoke its
+    evidence-free quarantines and propagate the typed error exactly
+    as the pre-bulkhead cohort did."""
+    from gelly_streaming_tpu.utils import resilience
+
+    streams = _streams(4, 1, seed=15)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    for tid in streams:
+        co.admit(tid)
+    for tid, (s, d) in streams.items():
+        co.feed(tid, s, d)
+
+    def always_fail(payload):
+        raise faults.InjectedFault("device is gone",
+                                   "cohort_dispatch")
+
+    with faults.inject(faults.FaultSpec(
+            site="cohort_dispatch", action="call", fn=always_fail,
+            times=10 ** 6)):
+        # the ORIGINAL typed error propagates (here the injected
+        # fault itself; a guarded-dispatch failure surfaces as the
+        # typed StageError) — pre-bulkhead semantics
+        with pytest.raises((resilience.StageError,
+                            faults.InjectedFault)):
+            co.pump()
+    assert co.quarantined() == []  # nobody blamed for the hardware
+    # the cohort recovers once the fault clears — same round, exact
+    oracle = _oracle(streams)
+    out = co.pump()
+    for tid in streams:
+        assert out[tid] == oracle[tid], tid
+
+
+def test_backpressure_reject_journals_nothing(monkeypatch, tmp_path):
+    """A backpressure-refused feed accepts nothing — so it must
+    journal nothing: the client's retry would otherwise
+    double-journal every reject and skew the source-offset domain."""
+    dlq_dir = _arm(monkeypatch, tmp_path)
+    monkeypatch.setenv("GS_TENANT_QUEUE_WINDOWS", "1")
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    # fill the queue to capacity (1 window)
+    co.feed("t", np.zeros(EB, np.int64), np.ones(EB, np.int64))
+    off_before = co.tenants["t"].fed_offset
+    batch_s = np.array([1, 500, 2], np.int64)
+    batch_d = np.array([2, 3, 4], np.int64)
+    from gelly_streaming_tpu.core.tenancy import TenantBackpressure
+
+    with pytest.raises(TenantBackpressure):
+        co.feed("t", batch_s, batch_d)
+    assert sanitize.scan(dlq_dir)["records"] == 0
+    assert co.tenants["t"].fed_offset == off_before
+    # drain and retry: the reject journals exactly once, offsets
+    # contiguous with the pre-refusal domain
+    co.pump()
+    co.feed("t", batch_s, batch_d)
+    info = sanitize.scan(dlq_dir)
+    assert info["records"] == 1 and info["edges"] == 1
+    rec = next(sanitize.replay(dlq_dir))
+    assert rec["offsets"].tolist() == [off_before + 1]
+
+
+def test_negative_outranks_overflow(monkeypatch, tmp_path):
+    """Severity order: a -2^40 id is id_negative (the pre-cast sign),
+    never the overflow its magnitude would also trip; huge parseable
+    object ints are id_overflow, not non_integer."""
+    _arm(monkeypatch, tmp_path, dlq=False)
+    rep = sanitize.sanitize(
+        np.array([-(2 ** 40), float(-(2 ** 40)), 2 ** 70],
+                 dtype=object),
+        np.array([1, 1, 1], dtype=object), VB)
+    assert rep.reasons == {"id_negative": 2, "id_overflow": 1}
+
+
+def test_serve_disarmed_never_wraps_huge_ids(monkeypatch):
+    """GS_SANITIZE=off keeps the legacy pre-cast: an out-of-int32 id
+    in a feed request must error, never silently wrap into a
+    plausible small id."""
+    from gelly_streaming_tpu.core.serve import StreamServer
+
+    monkeypatch.delenv("GS_SANITIZE", raising=False)
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    server = StreamServer(cohort, port=0)
+    try:
+        cohort.admit("t")
+        with pytest.raises((OverflowError, ValueError)):
+            server._op_feed({"tenant": "t", "src": [2 ** 40],
+                             "dst": [1]})
+        assert cohort.tenants["t"].queued == 0  # nothing admitted
+    finally:
+        server.close()
+
+
+def test_permanent_quarantine_refuses_feeds(monkeypatch):
+    monkeypatch.setenv("GS_QUARANTINE_WINDOWS", "0")
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    co.quarantine("t", "operator says no")
+    with pytest.raises(TenantQuarantined) as ei:
+        co.feed("t", np.array([1]), np.array([2]))
+    assert ei.value.probation_left == -1
+    # pump() must terminate with a suspended backlogged tenant
+    assert co.pump() == {}
+
+
+@pytest.mark.faults
+def test_quarantine_state_survives_checkpoint(monkeypatch, tmp_path):
+    monkeypatch.setenv("GS_QUARANTINE_WINDOWS", "3")
+    streams = _streams(2, 2, seed=14)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    for tid in streams:
+        co.admit(tid)
+    with faults.inject(_poison_plan("t1")):
+        for tid, (s, d) in streams.items():
+            co.feed(tid, s[:EB], d[:EB])
+        co.pump()
+    assert co.quarantined() == ["t1"]
+    t = co.tenants["t1"]
+    probation_before = t.probation
+    state = co.state_dict()
+    co2 = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co2.load_state_dict(state)
+    t2 = co2.tenants["t1"]
+    assert co2.tenant_tier("t1") == "quarantined"
+    assert t2.probation == probation_before
+    assert t2.quarantine_reason
+    # ... and a PRE-quarantine checkpoint rewinds the bulkhead
+    clean = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    clean.admit("t1")
+    pre = clean.tenant_state_dict("t1")
+    co2.load_tenant_state_dict("t1", pre)
+    assert co2.tenant_tier("t1") == "cohort"
+
+
+# ----------------------------------------------------------------------
+# serving surface + dlq_report
+# ----------------------------------------------------------------------
+def test_serve_feed_surfaces_rejections_and_status_dlq(monkeypatch,
+                                                       tmp_path):
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+
+    _arm(monkeypatch, tmp_path)
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    server = StreamServer(cohort, port=0).start()
+    cli = ServeClient(server.port)
+    try:
+        assert cli.admit("t")["ok"]
+        r = cli.feed("t", [1, 500, -2], [2, 3, 4])
+        assert r["ok"] and r["accepted"] == 1
+        assert r["rejected"] == 2
+        assert r["reasons"] == {"id_negative": 1,
+                                "id_out_of_range": 1}
+        st = cli.status()["serve"]
+        assert st["dlq"]["records"] == 2
+        assert st["sanitize"] == "on"
+        # clean feeds keep the legacy reply shape
+        r2 = cli.feed("t", [1], [2])
+        assert "rejected" not in r2 and "reasons" not in r2
+        # batch bound → typed wire error
+        monkeypatch.setenv("GS_MAX_BATCH_EDGES", "4")
+        r3 = cli.feed("t", [1] * 5, [2] * 5)
+        assert r3 == {"ok": False, "error": "BatchRejected",
+                      "tenant": "t", "reason": "batch_overflow",
+                      "size": 5, "limit": 4,
+                      "message": r3["message"]}
+    finally:
+        cli.close()
+        server.close()
+
+
+def test_serve_surfaces_quarantine(monkeypatch, tmp_path):
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+
+    monkeypatch.setenv("GS_QUARANTINE_WINDOWS", "0")
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    server = StreamServer(cohort, port=0).start()
+    cli = ServeClient(server.port)
+    try:
+        assert cli.admit("t")["ok"]
+        cohort.quarantine("t", "test")
+        r = cli.feed("t", [1], [2])
+        assert r["error"] == "TenantQuarantined"
+        assert r["probation_left"] == -1
+        assert cli.status()["serve"]["quarantined"] == ["t"]
+    finally:
+        cli.close()
+        server.close()
+
+
+def test_dlq_report_gather_reinject_replay_exact(monkeypatch,
+                                                 tmp_path):
+    from tools.dlq_report import gather, make_fix, reinject
+
+    dlq_dir = _arm(monkeypatch, tmp_path)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t")
+    # interleave two batches whose rejects land in different reason
+    # records; gather must restore ORIGINAL source order by offset
+    co.feed("t", np.array([500, -1, 501]), np.array([1, 2, 3]))
+    co.feed("t", np.array([-2, 502]), np.array([4, 5]))
+    offs, src, dst, reasons = gather(dlq_dir)["t"]
+    assert offs.tolist() == [0, 1, 2, 3, 4]
+    assert src.tolist() == [500, -1, 501, -2, 502]
+    fix = make_fix("mod:%d" % VB)
+    fixed = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    fixed.admit("t")
+    counts = reinject(dlq_dir, fixed.feed, fix=fix)
+    assert counts == {"t": 5}
+    got = fixed.close("t")
+    direct = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    direct.admit("t")
+    fs, fd = fix(src, dst)
+    direct.feed("t", fs, fd)
+    assert got == direct.close("t")
+
+
+def test_wire_fields_empty_on_clean_batch(monkeypatch, tmp_path):
+    _arm(monkeypatch, tmp_path, dlq=False)
+    rep = sanitize.sanitize(np.array([1, 2]), np.array([2, 3]), VB)
+    assert rep.clean and rep.wire_fields() == {}
+    bad = sanitize.sanitize(np.array([500]), np.array([1]), VB)
+    assert bad.wire_fields() == {
+        "rejected": 1, "reasons": {"id_out_of_range": 1}}
+
+
+def test_poison_smoke_constants_stay_in_sync():
+    """tools/chaos_run.leg_poison imports the smoke's stream shape —
+    pin the contract so a smoke refactor can't silently desync the
+    chaos leg."""
+    from tools import poison_smoke
+
+    assert poison_smoke.EB > 0 and poison_smoke.VB > poison_smoke.EB
+    assert callable(poison_smoke.hostile_bytes)
+    assert callable(poison_smoke.oracle_filter)

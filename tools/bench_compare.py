@@ -68,6 +68,13 @@ RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline",
 # 99}_s and PERF latency sections emit e2e_p{50,95,99}_s.
 LATENCY_SUFFIXES = ("_p50_s", "_p95_s", "_p99_s")
 
+# robustness counters (LOWER is better, zero is the healthy state):
+# rejected-record and quarantine totals a clean serving run must keep
+# at 0 — a baseline-0 counter that turns non-zero is a regression
+# regardless of ratio, and a non-zero baseline regresses past
+# 1 + tolerance like the latency identities
+COUNTER_FIELDS = ("dlq_records", "quarantines")
+
 # PERF.json sections that carry comparable rows, with the keys that
 # identify a row within the section
 PERF_SECTIONS = {
@@ -143,7 +150,8 @@ def extract_rows(doc, label: str) -> dict:
             ident = "%s[%s]" % (section, ",".join(
                 str(row.get(k)) for k in keys))
             add(ident, row)
-    for meta_key in ("telemetry_meta", "metrics", "latency"):
+    for meta_key in ("telemetry_meta", "metrics", "latency",
+                     "sanitize"):
         meta = doc.get(meta_key)
         if isinstance(meta, dict):
             add(meta_key, meta)
@@ -212,6 +220,24 @@ def compare(base_rows: dict, cur_rows: dict, tolerance: float) -> dict:
                    "ratio": round(ratio, 4)}
             compared.append(row)
             if ratio < 1.0 - tolerance:
+                regressions.append(dict(row, tolerance=tolerance))
+        # robustness counters: lower is better, and a clean (0)
+        # baseline turning non-zero is a regression outright — there
+        # is no ratio that makes new rejected records acceptable
+        for field in COUNTER_FIELDS:
+            bv, cv = b.get(field), c.get(field)
+            if not isinstance(bv, (int, float)) \
+                    or not isinstance(cv, (int, float)) \
+                    or isinstance(bv, bool) or isinstance(cv, bool):
+                continue
+            ratio = (cv / bv) if bv > 0 else float(cv)
+            row = {"row": ident, "field": field,
+                   "baseline": bv, "current": cv,
+                   "ratio": round(ratio, 4),
+                   "direction": "lower_is_better"}
+            compared.append(row)
+            if (bv == 0 and cv > 0) \
+                    or (bv > 0 and ratio > 1.0 + tolerance):
                 regressions.append(dict(row, tolerance=tolerance))
         # latency identities: every shared *_p{50,95,99}_s field,
         # compared inverted (LOWER is better — current/baseline past

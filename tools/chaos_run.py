@@ -47,6 +47,17 @@ Schedule (all deterministic, utils/faults — no randomness anywhere):
               0 with every accepted window in its results file and a
               SEALED journal
 
+  leg P — the POISON drill (utils/sanitize + the core/tenancy
+          bulkhead, GS_SANITIZE=on): an 8-tenant cohort with one
+          hostile tenant flooding garbage (byte soup through
+          native.parse_edge_bytes + a dispatch poison riding its
+          batches) — the bulkhead bisects the failing dispatch to the
+          hostile tenant and quarantines it, the 7 healthy tenants'
+          digests stay bit-identical to the fault-free oracle, every
+          rejected edge reconciles against the dead-letter journal,
+          and a serve subprocess under the same flood SIGTERM-drains
+          with exit 0
+
   leg M — the MESH drill (virtual n-device CPU mesh, armed via
           --mesh-devices; the process pins a CPU backend with that
           many virtual devices before jax initializes): a sharded
@@ -965,6 +976,244 @@ def leg_latency(workdir: str) -> dict:
     }
 
 
+def leg_poison(workdir: str) -> dict:
+    """The poison-input drill (utils/sanitize + the core/tenancy
+    bulkhead, GS_SANITIZE=on): an 8-tenant cohort with ONE hostile
+    tenant flooding garbage — byte soup through
+    native.parse_edge_bytes, out-of-range/negative/overflowing ids,
+    and a dispatch poison riding its batches.
+
+      · the 7 healthy tenants' per-tenant summary digests stay
+        BIT-IDENTICAL to the fault-free oracle while the bulkhead
+        bisects the failing dispatch to the hostile tenant and
+        quarantines it (durable `quarantine` event);
+      · every rejected edge is recoverable from the dead-letter
+        journal — counts AND (offset, src, dst) content reconcile
+        against a pure-Python policy oracle;
+      · a standalone serve subprocess fed the same hostile mix over a
+        real loopback socket drains on SIGTERM with exit 0, healthy
+        digests intact, and its DLQ depth equal to the sum of the
+        typed `rejected` counts its feed replies carried.
+    """
+    import numpy as np
+
+    from gelly_streaming_tpu import native
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.utils import sanitize
+    from tools.poison_smoke import (EB, VB, hostile_bytes,
+                                    oracle_filter)
+
+    eb, vb, num_w, n_tenants = EB, VB, 4, 8
+    hostile = "t7"
+    streams = {}
+    for i in range(n_tenants):
+        tid = "t%d" % i
+        s, d = make_stream(num_w * eb, vb, seed=130 + i)
+        streams[tid] = (s.astype(np.int64), d.astype(np.int64))
+    oracle = {}
+    for tid, (s, d) in streams.items():
+        if tid != hostile:
+            oracle[tid] = StreamSummaryEngine(
+                edge_bucket=eb, vertex_bucket=vb).process(s, d)
+
+    dlq_dir = os.path.join(workdir, "poison_dlq")
+    prev = {k: os.environ.get(k)
+            for k in ("GS_SANITIZE", "GS_DLQ_DIR")}
+    os.environ["GS_SANITIZE"] = "on"
+    os.environ["GS_DLQ_DIR"] = dlq_dir
+    try:
+        sanitize.reset()
+        cohort = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        for tid in streams:
+            cohort.admit(tid)
+
+        def poison(payload):
+            if payload and hostile in payload:
+                raise faults.InjectedFault(
+                    "hostile tenant poisons the dispatch",
+                    "cohort_dispatch")
+            return payload
+
+        hostile_rng = np.random.default_rng(77)
+        expected = []
+        hoff = 0
+        got = {}
+        with faults.inject(faults.FaultSpec(
+                site="cohort_dispatch", action="call", fn=poison,
+                times=10 ** 6)) as plan:
+            for w in range(num_w):
+                for tid, (s, d) in sorted(streams.items()):
+                    if tid == hostile:
+                        hs, hd, _ts = native.parse_edge_bytes(
+                            hostile_bytes(hostile_rng))
+                        keep = oracle_filter(hs, hd)
+                        for j in np.flatnonzero(~keep):
+                            expected.append((hoff + int(j),
+                                             int(hs[j]), int(hd[j])))
+                        hoff += len(hs)
+                        cohort.feed(tid, hs, hd)
+                    else:
+                        cohort.feed(tid, s[w * eb:(w + 1) * eb],
+                                    d[w * eb:(w + 1) * eb])
+                for k, v in cohort.pump().items():
+                    got.setdefault(k, []).extend(v)
+            fired = list(plan.fired)
+        quarantined = cohort.quarantined()
+        if quarantined != [hostile]:
+            raise SystemExit("chaos poison leg: expected exactly %r "
+                             "quarantined, got %r"
+                             % (hostile, quarantined))
+        for tid in sorted(oracle):
+            if got.get(tid, []) != oracle[tid]:
+                raise SystemExit(
+                    "chaos poison leg DIVERGED for healthy tenant %s "
+                    "(%d vs %d windows)" % (tid, len(got.get(tid, [])),
+                                            len(oracle[tid])))
+        quarantine_events = [
+            e for e in resilience.demotion_events()
+            if e.get("tenant") == hostile and e["to"] == "quarantined"]
+        if not quarantine_events:
+            raise SystemExit("chaos poison leg: no quarantine "
+                             "demotion event was recorded")
+
+        from tools.dlq_report import gather
+        info = sanitize.scan(dlq_dir)
+        rec = gather(dlq_dir).get(hostile)
+        recovered = (set() if rec is None else
+                     set(zip(rec[0].tolist(), rec[1].tolist(),
+                             rec[2].tolist())))
+        dlq_ok = (recovered == set(expected)
+                  and info["edges"] == len(expected))
+        if not dlq_ok:
+            raise SystemExit(
+                "chaos poison leg: DLQ holds %d edge(s), oracle "
+                "expected %d (content match: %s)"
+                % (info["edges"], len(expected),
+                   recovered == set(expected)))
+
+        drain = _poison_drain_subleg(workdir, np, streams, oracle,
+                                     eb, vb, num_w, hostile)
+    finally:
+        sanitize.reset()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {
+        "parity": True,
+        "quarantined": quarantined,
+        "dlq_recovered": True,
+        "dlq_edges": len(expected),
+        "faults_fired": [list(f) for f in fired],
+        "drain": drain,
+    }
+
+
+def _poison_drain_subleg(workdir, np, streams, oracle, eb, vb,
+                         num_w, hostile) -> dict:
+    """The serve half: a standalone subprocess armed with
+    GS_SANITIZE=on + its own DLQ, fed the hostile mix over a real
+    loopback socket, must SIGTERM-drain with exit 0, healthy digests
+    ≡ the oracle, and a DLQ depth equal to the sum of the typed
+    `rejected` counts the wire replies carried."""
+    import signal
+    import subprocess
+    import time
+
+    from gelly_streaming_tpu.core.serve import ServeClient
+    from gelly_streaming_tpu.utils import sanitize
+
+    drain_dlq = os.path.join(workdir, "poison_drain_dlq")
+    results = os.path.join(workdir, "poison_results.jsonl")
+    port_file = os.path.join(workdir, "poison_port.txt")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["GS_SANITIZE"] = "on"
+    env["GS_DLQ_DIR"] = drain_dlq
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gelly_streaming_tpu.core.serve",
+         "--edge-bucket", str(eb), "--vertex-bucket", str(vb),
+         "--port", "0", "--port-file", port_file,
+         "--wal", os.path.join(workdir, "poison_wal"),
+         "--ckpt", os.path.join(workdir, "poison_ckpt"),
+         "--ckpt-every", "2", "--results", results],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    t0 = time.monotonic()
+    while not os.path.exists(port_file):
+        if proc.poll() is not None or time.monotonic() - t0 > 120:
+            raise SystemExit("chaos poison drain: server never came "
+                             "up:\n%s"
+                             % proc.communicate()[0].decode()[-2000:])
+        time.sleep(0.05)
+    with open(port_file) as f:
+        port = int(f.read().strip())
+    cli = ServeClient(port, timeout=60)
+    rng = np.random.default_rng(99)
+    rejected_total = 0
+    for tid in sorted(streams):
+        assert cli.admit(tid)["ok"]
+    for w in range(num_w):
+        for tid, (s, d) in sorted(streams.items()):
+            if tid == hostile:
+                # garbage over the wire: out-of-range, negative and
+                # int32-overflowing ids mixed with valid ones
+                hs = rng.integers(-vb, 4 * vb, eb).astype(object)
+                hd = rng.integers(0, vb, eb).astype(object)
+                hs[::17] = 1 << 40
+                r = cli.request(op="feed", tenant=tid,
+                                src=[int(x) for x in hs],
+                                dst=[int(x) for x in hd])
+                if not r.get("ok"):
+                    raise SystemExit("chaos poison drain: hostile "
+                                     "feed errored: %s" % r)
+                rejected_total += int(r.get("rejected", 0))
+            else:
+                r = cli.feed(tid, s[w * eb:(w + 1) * eb].tolist(),
+                             d[w * eb:(w + 1) * eb].tolist())
+                if not r.get("ok") or r.get("rejected"):
+                    raise SystemExit("chaos poison drain: clean feed "
+                                     "rejected: %s" % r)
+    proc.send_signal(signal.SIGTERM)
+    out, _ = proc.communicate(timeout=180)
+    cli.close()
+    if proc.returncode != 0:
+        raise SystemExit("chaos poison drain: exit %d, want 0:\n%s"
+                         % (proc.returncode, out.decode()[-2000:]))
+    if rejected_total == 0:
+        raise SystemExit("chaos poison drain: the hostile feed was "
+                         "never rejected — the sanitizer did not arm")
+    got = {}
+    with open(results) as f:
+        for line in f:
+            row = json.loads(line)
+            got.setdefault(row["tenant"], {})[row["window"]] \
+                = row["summary"]
+    for tid in sorted(oracle):
+        final = [got.get(tid, {}).get(k)
+                 for k in sorted(got.get(tid, {}))]
+        if final != oracle[tid]:
+            raise SystemExit(
+                "chaos poison drain DIVERGED for healthy tenant %s "
+                "(%d vs %d windows)"
+                % (tid, len(final), len(oracle[tid])))
+    info = sanitize.scan(drain_dlq)
+    if info["edges"] != rejected_total:
+        raise SystemExit(
+            "chaos poison drain: DLQ holds %d edge(s) but the wire "
+            "replies reported %d rejected — a rejected record went "
+            "missing" % (info["edges"], rejected_total))
+    from gelly_streaming_tpu.utils import wal as wal_mod
+
+    sealed = wal_mod.scan(os.path.join(workdir, "poison_wal"))["sealed"]
+    if not sealed:
+        raise SystemExit("chaos poison drain: journal not sealed")
+    return {"rc": proc.returncode, "sealed": sealed,
+            "digest_match": True, "rejected_edges": rejected_total,
+            "dlq_edges": info["edges"]}
+
+
 def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
              workdir: str) -> dict:
     """The mesh drill: a sharded driver on the virtual CPU mesh takes
@@ -1382,6 +1631,13 @@ def main():
             # larger latency (never reset-to-zero) and their stage
             # waterfalls still reconcile
             ly = leg_latency(workdir)
+            # poison leg: one hostile tenant floods garbage — the
+            # sanitizer rejects to the DLQ (every record recoverable),
+            # the bulkhead bisects the poisoned dispatch and
+            # quarantines exactly the hostile stream, the 7 healthy
+            # tenants stay bit-identical, and a serve subprocess
+            # drains rc=0 under the same flood
+            po = leg_poison(workdir)
             # mesh leg: corrupt wire → retry, dead shard → demotion →
             # parity, n-shard checkpoint → 1-device + host-twin resume
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
@@ -1431,9 +1687,15 @@ def main():
         classes.add("serve_sigterm_drain")
     if ly["preserved"]:
         classes.add("latency_replay_stamps")
+    for site, _n, action in po["faults_fired"]:
+        if site == "cohort_dispatch" and action == "call":
+            classes.add("poison_isolation")
+    if po["dlq_recovered"]:
+        classes.add("dlq_recovery")
     required |= {"serve_kill_replay", "serve_torn_tail",
                  "serve_slow_client_shed", "serve_sigterm_drain",
-                 "latency_replay_stamps"}
+                 "latency_replay_stamps", "poison_isolation",
+                 "dlq_recovery"}
     if m is not None:
         for site, _n, action in m["faults_fired"]:
             if action == "corrupt_shard":
@@ -1463,6 +1725,7 @@ def main():
         "tenancy_leg": tn,
         "serve_leg": sv,
         "latency_leg": ly,
+        "poison_leg": po,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
         "gslint_leg": gl,

@@ -30,6 +30,12 @@
 #      per-window stage waterfalls SUM to the measured ingest→deliver
 #      end-to-end within 5% (tools/latency_report.py exits non-zero
 #      otherwise) — at summaries digest-identical to a disarmed run
+#   9. poison-input smoke (tools/poison_smoke.py): an 8-tenant cohort
+#      with one hostile tenant flooding garbage — the 7 healthy
+#      tenants' digests stay bit-identical to a fault-free oracle,
+#      the hostile stream is quarantined, and every rejected edge is
+#      recoverable from (and replay-exactly re-injectable out of) the
+#      dead-letter journal
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -38,33 +44,36 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/8] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/9] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/8] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/9] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/8] gslint =="
+echo "== [2/9] gslint =="
 python -m tools.gslint
 
-echo "== [3/8] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/9] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/8] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/9] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/8] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/9] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
-echo "== [6/8] serve parity smoke (loopback + drain ≡ direct feed) =="
+echo "== [6/9] serve parity smoke (loopback + drain ≡ direct feed) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
-echo "== [7/8] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
+echo "== [7/9] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
 JAX_PLATFORMS=cpu python tools/pallas_smoke.py
 
-echo "== [8/8] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
+echo "== [8/9] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
 JAX_PLATFORMS=cpu python tools/latency_smoke.py
+
+echo "== [9/9] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
+JAX_PLATFORMS=cpu python tools/poison_smoke.py
 
 echo "ci_check: all gates green"

@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""Dead-letter journal triage: render what the admission sanitizer
+rejected, and re-inject it after an operator fix.
+
+The sanitizer (utils/sanitize.py, GS_SANITIZE) peels structurally
+invalid records off every admission boundary into a CRC-framed
+segment journal under GS_DLQ_DIR — origin tenant, absolute source
+offsets, typed reason code, and the rejected edges themselves. This
+tool is the operator's other half of that contract:
+
+  render      per tenant × reason counts, segment inventory, sample
+              rows — "what is my hostile client actually sending?"
+  --export    dump one tenant's (or everyone's) rejected edges as
+              'src dst' lines for offline analysis
+  --reinject  feed the rejected records back through a live serving
+              front-end (core/serve wire protocol) in ORIGINAL source
+              order — per tenant, records are merged by their recorded
+              source offsets, so re-injection is replay-exact: the
+              edges arrive in exactly the order they were first fed.
+              Combine with --fix once the root cause is addressed
+              (e.g. `--fix mod:<vb>` maps out-of-range ids into the
+              bucket after a wrong-bucket deploy).
+
+Usage:
+  python tools/dlq_report.py DIR [--json] [--tenant T]
+  python tools/dlq_report.py DIR --export edges.txt [--tenant T]
+  python tools/dlq_report.py DIR --reinject PORT [--fix mod:VB]
+
+Exit 0 on success (render mode exits 0 even on an empty journal —
+empty is the healthy state); 1 on re-injection failures.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from gelly_streaming_tpu.utils import sanitize  # noqa: E402
+
+
+def gather(directory: str, tenant=None):
+    """{tenant: (offsets, src, dst, reasons)} merged across records
+    and sorted by source offset — the original feed order."""
+    per = {}
+    for rec in sanitize.replay(directory):
+        if tenant is not None and rec["tenant"] != str(tenant):
+            continue
+        slot = per.setdefault(rec["tenant"], [[], [], [], []])
+        slot[0].append(rec["offsets"])
+        slot[1].append(rec["src"])
+        slot[2].append(rec["dst"])
+        slot[3].extend([rec["reason"]] * len(rec["src"]))
+    out = {}
+    for tid, (offs, srcs, dsts, reasons) in per.items():
+        o = np.concatenate(offs) if offs else np.zeros(0, np.int64)
+        s = np.concatenate(srcs) if srcs else np.zeros(0, np.int64)
+        d = np.concatenate(dsts) if dsts else np.zeros(0, np.int64)
+        r = np.array(reasons, object)
+        order = np.argsort(o, kind="stable")
+        out[tid] = (o[order], s[order], d[order], r[order])
+    return out
+
+
+def make_fix(spec):
+    """An edge transform from a --fix spec: `mod:VB` maps both ids
+    into [0, VB) (the wrong-bucket deploy repair); None = identity."""
+    if spec is None:
+        return None
+    kind, _, arg = spec.partition(":")
+    if kind == "mod":
+        vb = int(arg)
+
+        def fix(src, dst):
+            return np.mod(src, vb), np.mod(dst, vb)
+
+        return fix
+    raise ValueError("unknown --fix spec %r (supported: mod:VB)" % spec)
+
+
+def reinject(directory: str, feed, tenant=None, fix=None,
+             batch: int = 4096) -> dict:
+    """Feed every journaled record back through `feed(tenant, src,
+    dst)` (any callable with the cohort-feed signature) in original
+    source order, `fix`-transformed when given. Returns per-tenant
+    re-injected edge counts. The caller owns backpressure retries —
+    a feed() that raises aborts with the exception."""
+    counts = {}
+    for tid, (offs, src, dst, _r) in sorted(
+            gather(directory, tenant).items()):
+        if fix is not None:
+            src, dst = fix(src, dst)
+        for lo in range(0, len(src), batch):
+            feed(tid, src[lo:lo + batch], dst[lo:lo + batch])
+        counts[tid] = int(len(src))
+    return counts
+
+
+def render(directory: str, tenant=None, as_json=False,
+           samples: int = 3) -> str:
+    info = sanitize.scan(directory)
+    if as_json:
+        return json.dumps(info, indent=2, sort_keys=True)
+    lines = ["dead-letter journal %s" % directory,
+             "  records: %d   edges: %d   segments: %d"
+             % (info["records"], info["edges"], info["segments"])]
+    if not info["records"]:
+        lines.append("  (empty — the healthy state)")
+        return "\n".join(lines)
+    lines.append("  by reason: " + "  ".join(
+        "%s=%d" % kv for kv in sorted(info["by_reason"].items())))
+    for tid, (offs, src, dst, reasons) in sorted(
+            gather(directory, tenant).items()):
+        lines.append("  tenant %r: %d rejected edge(s)"
+                     % (tid, len(src)))
+        for i in range(min(samples, len(src))):
+            lines.append("    offset %d: (%d, %d) — %s"
+                         % (offs[i], src[i], dst[i], reasons[i]))
+        if len(src) > samples:
+            lines.append("    ... %d more" % (len(src) - samples))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0])
+    ap.add_argument("dir", help="dead-letter journal directory "
+                               "(GS_DLQ_DIR)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable scan summary")
+    ap.add_argument("--tenant", default=None,
+                    help="restrict to one origin tenant")
+    ap.add_argument("--export", default=None, metavar="PATH",
+                    help="write rejected edges as 'src dst' lines")
+    ap.add_argument("--reinject", type=int, default=None,
+                    metavar="PORT",
+                    help="feed records back through a live serve "
+                         "front-end on 127.0.0.1:PORT")
+    ap.add_argument("--fix", default=None,
+                    help="edge transform before re-injection "
+                         "(`mod:VB`)")
+    args = ap.parse_args(argv)
+
+    if args.export:
+        per = gather(args.dir, args.tenant)
+        n = 0
+        with open(args.export, "w") as f:
+            for tid, (_o, src, dst, _r) in sorted(per.items()):
+                for s, d in zip(src.tolist(), dst.tolist()):
+                    f.write("%d %d\n" % (s, d))
+                    n += 1
+        print("exported %d edge(s) to %s" % (n, args.export))
+        return 0
+
+    if args.reinject is not None:
+        from gelly_streaming_tpu.core.serve import ServeClient
+
+        fix = make_fix(args.fix)
+        cli = ServeClient(args.reinject)
+        try:
+            def feed(tid, src, dst):
+                r = cli.request(op="feed", tenant=tid,
+                                src=np.asarray(src).tolist(),
+                                dst=np.asarray(dst).tolist())
+                if not r.get("ok"):
+                    raise RuntimeError(
+                        "re-injection refused for tenant %r: %s"
+                        % (tid, r))
+
+            counts = reinject(args.dir, feed, tenant=args.tenant,
+                              fix=fix)
+        except (RuntimeError, OSError) as e:
+            print("dlq_report: re-injection failed: %s" % e,
+                  file=sys.stderr)
+            return 1
+        finally:
+            cli.close()
+        print("re-injected: %s" % json.dumps(counts, sort_keys=True))
+        return 0
+
+    print(render(args.dir, tenant=args.tenant, as_json=args.json))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

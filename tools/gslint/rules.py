@@ -549,6 +549,9 @@ class ThreadSharedRule(Rule):
         # the journal they append through (ISSUE 12)
         PKG + "/utils/wal.py",
         PKG + "/core/serve.py",
+        # the admission sanitizer + dead-letter journal: serve
+        # connection threads and the pump both reject (ISSUE 15)
+        PKG + "/utils/sanitize.py",
     )
 
     def check_module(self, ctx: ModuleCtx) -> List[Finding]:

@@ -88,6 +88,15 @@ DICT_SECTIONS = {
     "latency": ("engine", "parity", "overhead_ratio",
                 "disarmed_edges_per_s", "armed_edges_per_s",
                 "reconciled_windows", "e2e_p99_s"),
+    # admission-sanitizer overhead proof (utils/sanitize,
+    # tools/profile_kernels.py section_sanitize): armed-vs-disarmed
+    # wall ratio at digest parity on the 524K/32768 row, plus the
+    # dlq_records/quarantines counters bench_compare checks
+    # not-worse — the committed evidence for the GS_SANITIZE ≤1.02×
+    # bar
+    "sanitize": ("engine", "parity", "overhead_ratio",
+                 "disarmed_edges_per_s", "armed_edges_per_s",
+                 "dlq_records", "quarantines"),
 }
 
 # per-row required keys of the cost_model section's `programs` list
@@ -240,6 +249,12 @@ _CHAOS_LEGS = {
     # report honest, larger latency, never reset-to-zero — at armed
     # summaries digest-identical to the fault-free oracle
     "latency_leg": ("parity", "preserved", "replayed_windows"),
+    # the poison-input drill (ISSUE 15): a hostile tenant flooding
+    # garbage is sanitized (every rejected edge recoverable from the
+    # dead-letter journal) and quarantined by the cohort bulkhead
+    # while the healthy tenants stay bit-identical; the serve
+    # subprocess under the flood must still drain rc=0
+    "poison_leg": ("parity", "quarantined", "dlq_recovered", "drain"),
 }
 
 
@@ -274,19 +289,22 @@ def validate_chaos(doc) -> list:
                               % (leg, key))
         if val.get("parity") is not True:
             errors.append("%s: leg 'parity' must be true" % leg)
-    serve = doc.get("serve_leg")
-    if isinstance(serve, dict):
-        drain = serve.get("drain")
+    for leg_name in ("serve_leg", "poison_leg"):
+        leg = doc.get(leg_name)
+        if not isinstance(leg, dict):
+            continue
+        drain = leg.get("drain")
         if isinstance(drain, dict):
             for key in ("rc", "sealed", "digest_match"):
                 if key not in drain:
-                    errors.append("serve_leg.drain: missing required "
-                                  "key %r" % key)
+                    errors.append("%s.drain: missing required "
+                                  "key %r" % (leg_name, key))
             if drain.get("rc") != 0:
-                errors.append("serve_leg.drain: SIGTERM drain must "
-                              "exit 0 (got %r)" % (drain.get("rc"),))
+                errors.append("%s.drain: SIGTERM drain must "
+                              "exit 0 (got %r)"
+                              % (leg_name, drain.get("rc")))
         elif drain is not None:
-            errors.append("serve_leg.drain: expected a dict")
+            errors.append("%s.drain: expected a dict" % leg_name)
     return errors
 
 

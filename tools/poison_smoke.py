@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Poison-input hardening smoke — CI gate (tools/ci_check.sh).
+
+An 8-tenant cohort with ONE hostile tenant flooding garbage (byte
+soup through `native.parse_edge_bytes`, out-of-range / negative /
+int32-overflowing ids, and a poisoned dispatch) must:
+
+  1. keep the 7 healthy tenants' per-tenant summary digests
+     BIT-IDENTICAL to a fault-free oracle (the admission sanitizer +
+     cohort bulkhead change availability for the hostile stream only,
+     never results for anyone else);
+  2. quarantine the hostile tenant (durable bulkhead state) instead
+     of letting its poisoned dispatch take the cohort down;
+  3. record EVERY rejected edge in the dead-letter journal — counts
+     and (offset, src, dst) content both reconcile against a
+     pure-Python oracle of the sanitizer's policy;
+  4. re-inject replay-exactly: after an operator fix (`mod:vb`), the
+     DLQ records fed back through tools/dlq_report.reinject produce
+     digests identical to feeding the fixed edges directly — source
+     offsets restore the ORIGINAL feed order.
+
+Exit 0 = clean. Runs in seconds on the CPU backend.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from bench import make_stream  # noqa: E402
+from gelly_streaming_tpu import native  # noqa: E402
+from gelly_streaming_tpu.core.tenancy import TenantCohort  # noqa: E402
+from gelly_streaming_tpu.ops.scan_analytics import (  # noqa: E402
+    StreamSummaryEngine)
+from gelly_streaming_tpu.utils import faults  # noqa: E402
+from gelly_streaming_tpu.utils import sanitize  # noqa: E402
+
+EB, VB, NUM_W, N_TENANTS = 256, 512, 4, 8
+HOSTILE = "t7"
+
+
+def digest(summaries) -> str:
+    h = hashlib.sha256()
+    for s in summaries:
+        h.update(json.dumps(s, sort_keys=True).encode())
+    return h.hexdigest()[:16]
+
+
+def hostile_bytes(rng) -> bytes:
+    """The hostile tenant's wire payload: random byte soup mixed with
+    parseable lines whose ids are garbage — what a buggy (or
+    malicious) client actually produces."""
+    lines = []
+    for i in range(EB):
+        r = int(rng.integers(0, 6))
+        if r == 0:
+            lines.append(bytes(rng.integers(32, 127, 12,
+                                            dtype=np.uint8)))
+        elif r == 1:
+            lines.append(b"%d %d" % (rng.integers(VB, 1 << 40),
+                                     rng.integers(0, VB)))
+        elif r == 2:
+            lines.append(b"%d %d" % (-rng.integers(1, 1 << 20),
+                                     rng.integers(0, VB)))
+        elif r == 3:
+            lines.append(b"nan inf")
+        else:
+            lines.append(b"%d %d" % (rng.integers(0, VB),
+                                     rng.integers(0, VB)))
+    return b"\n".join(lines) + b"\n"
+
+
+def oracle_filter(src, dst) -> np.ndarray:
+    """Pure-Python twin of the sanitizer's `on` policy for dense ids:
+    keep mask (the fuzz contract utils/sanitize must match)."""
+    keep = []
+    for s, d in zip(src.tolist(), dst.tolist()):
+        keep.append(0 <= s < VB and 0 <= d < VB)
+    return np.array(keep, bool)
+
+
+def main() -> int:
+    rng = np.random.default_rng(42)
+    streams = {}
+    for i in range(N_TENANTS):
+        tid = "t%d" % i
+        s, d = make_stream(NUM_W * EB, VB, seed=100 + i)
+        streams[tid] = (s.astype(np.int64), d.astype(np.int64))
+
+    # fault-free oracle: each healthy tenant through its own engine
+    want = {}
+    for tid, (s, d) in streams.items():
+        if tid == HOSTILE:
+            continue
+        eng = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+        eng.reset()
+        want[tid] = digest(eng.process(s, d))
+
+    with tempfile.TemporaryDirectory(prefix="gs-poison-smoke-") as wd:
+        dlq_dir = os.path.join(wd, "dlq")
+        prev = {k: os.environ.get(k)
+                for k in ("GS_SANITIZE", "GS_DLQ_DIR")}
+        os.environ["GS_SANITIZE"] = "on"
+        os.environ["GS_DLQ_DIR"] = dlq_dir
+        try:
+            sanitize.reset()
+            cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+            for tid in streams:
+                cohort.admit(tid)
+
+            # the dispatch poison rides the hostile tenant: any
+            # cohort batch containing it fails typed until the
+            # bulkhead isolates it (bisect → quarantine)
+            def poison(payload):
+                if payload and HOSTILE in payload:
+                    raise faults.InjectedFault(
+                        "hostile tenant poisons the dispatch",
+                        "cohort_dispatch")
+                return payload
+
+            hostile_rng = np.random.default_rng(7)
+            expected_rejects = []  # (offset, src, dst) oracle
+            hostile_off = 0
+            got = {}
+            with faults.inject(faults.FaultSpec(
+                    site="cohort_dispatch", action="call", fn=poison,
+                    times=10 ** 6)):
+                for w in range(NUM_W):
+                    for tid, (s, d) in sorted(streams.items()):
+                        if tid == HOSTILE:
+                            hs, hd, _ts = native.parse_edge_bytes(
+                                hostile_bytes(hostile_rng))
+                            keep = oracle_filter(hs, hd)
+                            for j in np.flatnonzero(~keep):
+                                expected_rejects.append(
+                                    (hostile_off + int(j),
+                                     int(hs[j]), int(hd[j])))
+                            hostile_off += len(hs)
+                            cohort.feed(tid, hs, hd)
+                        else:
+                            cohort.feed(tid, s[w * EB:(w + 1) * EB],
+                                        d[w * EB:(w + 1) * EB])
+                    for k, v in cohort.pump().items():
+                        got.setdefault(k, []).extend(v)
+
+            if cohort.tenant_tier(HOSTILE) != "quarantined":
+                print("poison smoke FAILED: hostile tenant not "
+                      "quarantined (tier=%s)"
+                      % cohort.tenant_tier(HOSTILE))
+                return 1
+            for tid in sorted(want):
+                have = digest(got.get(tid, []))
+                if have != want[tid] \
+                        or len(got.get(tid, [])) != NUM_W:
+                    print("poison smoke FAILED: healthy tenant %s "
+                          "diverged (%s != %s, %d windows)"
+                          % (tid, have, want[tid],
+                             len(got.get(tid, []))))
+                    return 1
+
+            # every rejected record recoverable from the DLQ
+            info = sanitize.scan(dlq_dir)
+            from tools.dlq_report import gather, make_fix, reinject
+            per = gather(dlq_dir)
+            rec = per.get(HOSTILE)
+            recovered = (set() if rec is None else
+                         set(zip(rec[0].tolist(), rec[1].tolist(),
+                                 rec[2].tolist())))
+            if recovered != set(expected_rejects) \
+                    or info["edges"] != len(expected_rejects):
+                print("poison smoke FAILED: DLQ holds %d edge(s), "
+                      "oracle expected %d (content match: %s)"
+                      % (info["edges"], len(expected_rejects),
+                         recovered == set(expected_rejects)))
+                return 1
+
+            # replay-exact re-injection after the operator fix
+            fix = make_fix("mod:%d" % VB)
+            fixed = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+            fixed.admit(HOSTILE)
+            counts = reinject(dlq_dir, fixed.feed, fix=fix)
+            reinjected = fixed.close(HOSTILE)
+            offs, rs, rd, _reasons = per[HOSTILE]
+            fs, fd = fix(rs, rd)
+            direct = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+            direct.admit(HOSTILE)
+            direct.feed(HOSTILE, fs, fd)
+            if digest(reinjected) != digest(direct.close(HOSTILE)) \
+                    or counts.get(HOSTILE) != len(expected_rejects):
+                print("poison smoke FAILED: re-injection is not "
+                      "replay-exact (%s)" % counts)
+                return 1
+        finally:
+            sanitize.reset()
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    print("poison smoke ok: 7 healthy tenants bit-identical, hostile "
+          "quarantined, %d rejected edge(s) recovered + re-injected "
+          "replay-exact" % len(expected_rejects))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1372,6 +1372,72 @@ def section_latency(results: dict) -> None:
     results["latency"] = meta
 
 
+def section_sanitize(results: dict) -> None:
+    """Admission-sanitizer evidence (utils/sanitize): the armed
+    sanitizer on the 524K/32768 fused-scan row must (a) change NO
+    summary on a clean stream — asserted identical to the disarmed
+    run, and (b) stay under the 1.02× armed-overhead bar (the
+    sanitizer is a handful of vectorized numpy passes against seconds
+    of scan work). The committed meta is the schema-validated
+    `sanitize` section (tools/perf_schema.py); its dlq_records /
+    quarantines counters feed bench_compare's not-worse checks — a
+    clean row must commit both at 0."""
+    from bench import make_stream
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        StreamSummaryEngine)
+    from gelly_streaming_tpu.utils import resilience as _resilience
+    from gelly_streaming_tpu.utils import sanitize as _sanitize
+
+    eb, vb = 32768, 65536
+    edges = int(os.environ.get("GS_TELEMETRY_EDGES", 524288))
+    src, dst = make_stream(edges, vb)
+    prev = {k: os.environ.get(k)
+            for k in ("GS_SANITIZE", "GS_DLQ_DIR")}
+    try:
+        os.environ["GS_SANITIZE"] = "off"
+        os.environ.pop("GS_DLQ_DIR", None)
+        eng = StreamSummaryEngine(edge_bucket=eb, vertex_bucket=vb)
+
+        def run():
+            eng.reset()
+            return eng.process(src, dst)
+
+        base = run()  # warm + baseline summaries
+        off_s = _timeit(run, reps=5, warmup=1)
+        # mode `on` (structural checks): inert on a clean in-range
+        # stream by construction. `strict` is a POLICY change (it
+        # rejects self-loops, which a random stream contains), so
+        # parity is only a contract for `on`.
+        os.environ["GS_SANITIZE"] = "on"
+        armed = run()
+        if armed != base:
+            raise AssertionError(
+                "armed sanitizer changed a clean stream's summaries "
+                "— the inert-on-clean contract is broken")
+        on_s = _timeit(run, reps=5, warmup=1)
+        dlq = _sanitize.dlq_status()
+        meta = {
+            "engine": "fused_scan",
+            "edge_bucket": eb, "num_edges": edges,
+            "mode": "on",
+            "parity": True,
+            "disarmed_edges_per_s": round(edges / off_s),
+            "armed_edges_per_s": round(edges / on_s),
+            "overhead_ratio": round(on_s / off_s, 3),
+            "dlq_records": 0 if dlq is None else int(dlq["records"]),
+            "quarantines": sum(
+                1 for e in _resilience.demotion_events()
+                if e.get("to") == "quarantined"),
+        }
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    results["sanitize"] = meta
+
+
 def section_cost_model(results: dict) -> None:
     """Program cost observatory evidence (utils/costmodel): capture
     XLA cost_analysis-derived FLOPs/bytes for the three hot stream
@@ -1708,6 +1774,7 @@ SECTIONS = {
     "telemetry": section_telemetry,
     "metrics": section_metrics,
     "latency": section_latency,
+    "sanitize": section_sanitize,
     "window": section_window,
     "host_stream": section_host_stream,
     "pipeline_stages": section_pipeline,
