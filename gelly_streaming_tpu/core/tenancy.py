@@ -45,11 +45,31 @@ The serving pieces around the slab:
   cohort keeps dispatching the healthy tenants. The sick tenant's
   stream continues on the single tier — same summaries, its own
   dispatches — and its checkpoints stay engine-interchangeable.
+- **Resident cohort tier.** With
+  ops/resident_engine.resolve_resident_cohort selected
+  (GS_COHORT_RESIDENT pin or committed `tenancy_ab`/`cohort_resident`
+  parity+speedup rows), the per-(vb, kb) group's carries live as ONE
+  stacked `[N, ...]` pytree on device between rounds, updated in
+  place by a donated super-batch program
+  (`jax.jit(..., donate_argnums)` where the backend honors donation)
+  that folds up to GS_RESIDENT_SPB windows per tenant per dispatch —
+  no per-round restack, no per-tenant carry h2d. Per-tenant
+  checkpoints gather their slice at super-batch boundaries
+  (`_carry_of`), so the ISSUE-11/12 checkpoint, WAL-replay,
+  bulkhead-bisect, and demotion contracts hold per tenant unchanged;
+  membership changes (admit/close/quarantine/demote/restore) break
+  residency and restack. The window body itself may additionally be
+  the tenant-axis Pallas megakernel
+  (ops/pallas_window.maybe_cohort_body, its own GS_COHORT_PALLAS
+  gate).
 - **Autotuning.** The dispatch autotuner (ops/autotune.DispatchTuner,
-  family `tenant_cohort`) gains a tenants-per-dispatch arm: pump
-  rounds chunk the ready tenants into `tpd`-sized vmapped dispatches
-  and feed the measured edges/s back. GS_TENANT_TPD pins the arm;
-  GS_AUTOTUNE=0 dispatches all ready tenants in one slab.
+  family `tenant_cohort`, keyed by eb/vb AND the live cohort bucket
+  Nb — a grown cohort re-keys instead of inheriting stale optima)
+  gains a tenants-per-dispatch arm (× windows-per-superbatch on the
+  resident tier): pump rounds chunk the ready tenants into
+  `tpd`-sized vmapped dispatches and feed the measured edges/s back.
+  GS_TENANT_TPD pins the arm; GS_AUTOTUNE=0 dispatches all ready
+  tenants in one slab.
 - **Observability.** Every finalized tenant window marks
   metrics.mark_window(tenant=...) — per-tenant window/edge counters
   and staleness rows on /healthz + /metrics under the registry's
@@ -207,7 +227,8 @@ class _Tenant:
                  "windows_done", "closed_partial", "closing", "closed",
                  "tier", "engine", "ckpt_policy", "dropped_edges",
                  "bp_stamped", "fed_offset", "probation",
-                 "quarantine_reason", "last_report")
+                 "quarantine_reason", "last_report", "res_row",
+                 "last_ts")
 
     def __init__(self, tid: str, vb: int, kb: int):
         self.tid = tid
@@ -217,6 +238,9 @@ class _Tenant:
         self.dst = np.zeros(0, np.int32)
         self.bp_stamped = False    # durable-once-per-overflow-episode
         self.carry = None          # lazy: built at first dispatch
+        self.res_row = None        # row in the resident cohort stack
+                                   # (carry lives THERE, not here)
+        self.last_ts = None        # newest accepted event-time stamp
         self.windows_done = 0
         self.closed_partial = False
         self.closing = False
@@ -269,6 +293,18 @@ class TenantCohort:
         self._pad_carries = {}     # (vb,) -> fresh host carry template
         self._tri_redo = {}        # (vb, kb) -> escalated exact kernel
         self._tuners = {}          # (vb,) -> DispatchTuner (tpd arm)
+        self._tuner_nb = {}        # (vb,) -> Nb the tuner was keyed at
+        # resident cohort tier (ops/resident_engine
+        # .resolve_resident_cohort): per (vb, kb) group, the stacked
+        # [N, ...] carry pytree kept ON DEVICE between rounds —
+        # {"nb": rows, "rows": (tid|None, ...), "carry": 3-tuple} —
+        # updated in place by the donated super-batch program and
+        # restacked only when membership changes; per-tenant
+        # checkpoint gathers slice it at super-batch boundaries
+        self._res = {}
+        self._res_programs = {}    # (vb, kb, nb, wb) -> donated program
+        self.resident_dispatches = 0  # dispatches through the tier
+        self._round_spb = 0        # this round's windows-per-superbatch arm
         self._ring = resident_engine.IngestRing()
         self._ckpt_dir = None
         self._ckpt_every_n = 0
@@ -352,7 +388,37 @@ class TenantCohort:
     # ------------------------------------------------------------------
     # feed / backpressure
     # ------------------------------------------------------------------
-    def feed(self, tenant_id, src, dst) -> int:
+    def _check_event_time(self, t: _Tenant, src, ts):
+        """Per-tenant event-time monotonicity (the cohort-aware
+        guard): the optional ts column must align with the batch, be
+        non-decreasing WITHIN it, and start at or after the tenant's
+        newest accepted stamp. Checked independently per tenant — a
+        slab interleaving tenants with disjoint time ranges is the
+        normal serving shape, so nothing here ever compares clocks
+        ACROSS tenants. Returns the validated int64 column (None when
+        no column was given); raises ValueError naming the tenant on
+        a regression, consuming nothing."""
+        if ts is None:
+            return None
+        col = np.asarray(ts, np.int64)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+        if col.shape != (len(src),):
+            raise ValueError(
+                "tenant %r ts column length %d != batch length %d"
+                % (t.tid, col.size, len(src)))
+        if col.size == 0:
+            return col
+        if col.size > 1 and bool(np.any(np.diff(col) < 0)):  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+            raise ValueError(
+                "tenant %r event-time regression WITHIN the batch: "
+                "ts must be non-decreasing per tenant" % t.tid)
+        if t.last_ts is not None and int(col[0]) < t.last_ts:  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+            raise ValueError(
+                "tenant %r event-time regression: batch starts at "
+                "%d but the tenant's stream already reached %d"
+                % (t.tid, int(col[0]), t.last_ts))  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+        return col
+
+    def feed(self, tenant_id, src, dst, ts=None) -> int:
         """Append edges to one tenant's bounded queue. Returns the
         number of edges accepted. Past capacity
         (GS_TENANT_QUEUE_WINDOWS x edge_bucket edges), the
@@ -361,6 +427,15 @@ class TenantCohort:
         an atomic refusal can't split a window across a retry
         boundary), `drop` accepts what fits and sheds the rest with a
         durable event + counter.
+
+        `ts` is an optional per-edge EVENT-TIME column (int64,
+        non-decreasing). Tenants sharing a slab legitimately carry
+        disjoint, interleaved time ranges, so the monotonicity guard
+        keys on THE TENANT, never the batch or the slab: the column
+        must be non-decreasing within the batch AND start at or after
+        this tenant's newest accepted stamp. A regression refuses the
+        whole batch (ValueError, nothing consumed) for that tenant
+        only — other tenants' clocks are untouched.
 
         With the latency plane armed (GS_LATENCY=1), the accepted
         batch is stamped with a monotonic admission timestamp at THIS
@@ -385,6 +460,11 @@ class TenantCohort:
         got = faults.fire("admit", (t.tid, src, dst))
         if got is not None:
             _tid, src, dst = got
+        # cohort-aware event-time guard: validated against THIS
+        # tenant's clock before anything is consumed — a regression
+        # refuses the batch atomically (last_ts advances only below,
+        # once the batch clears the capacity gate)
+        ts_col = self._check_event_time(t, src, ts)
         t.last_report = None
         report = None
         if sanitize_mod.enabled():
@@ -455,6 +535,11 @@ class TenantCohort:
             t.last_report = report
         else:
             t.fed_offset += len(src)
+        if ts_col is not None and len(ts_col):
+            # the batch is consumed (fully, or drop-policy partially —
+            # shed edges are gone either way): this tenant's event
+            # clock advances to the batch's newest validated stamp
+            t.last_ts = int(ts_col[-1])  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
         if take:
             if self._wal is not None:
                 # durability boundary: the accepted edges hit the
@@ -499,16 +584,79 @@ class TenantCohort:
         """The jitted cohort program at this slab shape (one per
         power-of-two (tenants, windows) bucket — ragged cohorts reuse
         O(log N x log W) programs, never one per population). Wrapped
-        by the compile watch / cost observatory as `cohort_scan`."""
+        by the compile watch / cost observatory as `cohort_scan`. The
+        window body is the vmapped XLA scan, or the tenant-axis
+        Pallas megakernel when its own gate clears
+        (scan_analytics.build_cohort_scan's nb path)."""
         key = (vb, kb, nb, wb)
         fn = self._programs.get(key)
         if fn is None:
             import jax
 
-            run = scan_analytics.build_cohort_scan(self.eb, vb, kb)
+            run = scan_analytics.build_cohort_scan(self.eb, vb, kb,
+                                                   nb=nb)
             fn = self._programs[key] = metrics.wrap_jit(
                 "cohort_scan", jax.jit(run))
         return fn
+
+    def _res_program(self, vb: int, kb: int, nb: int, wb: int):
+        """The RESIDENT cohort program: the same cohort scan jitted
+        with explicit donation of the stacked carry argument
+        (resident_engine.donate_kw — in-place slab updates where the
+        backend honors donation, bit-identical undonated elsewhere).
+        Kept a separate program cache from _program: donation changes
+        the jit signature, never the math."""
+        key = (vb, kb, nb, wb)
+        fn = self._res_programs.get(key)
+        if fn is None:
+            import jax
+
+            run = scan_analytics.build_cohort_scan(self.eb, vb, kb,
+                                                   nb=nb)
+            fn = self._res_programs[key] = metrics.wrap_jit(
+                "cohort_resident",
+                jax.jit(run, **resident_engine.donate_kw()))
+        return fn
+
+    def _carry_of(self, t: _Tenant):
+        """One tenant's live carry wherever it resides: its row of
+        the resident cohort stack when the resident tier holds it,
+        else its per-tenant carry, else the fresh zero-stream state.
+        Pure read — never mutates tenant or stack state."""
+        if t.res_row is not None:
+            entry = self._res[(t.vb, t.kb)]
+            return tuple(a[t.res_row] for a in entry["carry"])
+        return (t.carry if t.carry is not None
+                else self._fresh_carry(t.vb))
+
+    def _evict_resident(self, key) -> None:
+        """Materialize EVERY tenant out of one (vb, kb) resident stack
+        and drop it. MUST run before anything replaces the stack at
+        this key: a tenant whose res_row still points into a replaced
+        stack would materialize a stranger's (or a pad row's fresh)
+        carry and silently lose its own. Slicing the stacked leaves
+        is the per-tenant gather the super-batch boundary contract
+        names."""
+        entry = self._res.pop(key, None)
+        if entry is None:
+            return
+        for tid in entry["rows"]:
+            other = self.tenants.get(tid) if tid else None
+            if other is not None and other.res_row is not None:
+                other.carry = tuple(a[other.res_row]
+                                    for a in entry["carry"])
+                other.res_row = None
+
+    def _break_residency(self, t: _Tenant) -> None:
+        """Materialize every tenant out of the resident stack this
+        tenant shares, then drop the stack: membership is about to
+        change (checkpoint restore, quarantine, demotion, probation
+        absorb), so the next resident dispatch must restack from
+        per-tenant carries."""
+        if t.res_row is None:
+            return
+        self._evict_resident((t.vb, t.kb))
+        t.res_row = None
 
     def _redo_kernel(self, vb: int, kb: int):
         """The escalated exact triangle recount of one K-overflowing
@@ -521,23 +669,64 @@ class TenantCohort:
                 k_bucket=4 * kb)
         return k
 
+    def _cohort_nb(self, vb: int) -> int:
+        """Power-of-two bucket of the live cohort-tier population at
+        this vertex bucket, capped at the admission cap — the slab's
+        row dimension, and the arm-family key's N term."""
+        n = sum(1 for t in self.tenants.values()
+                if t.tier == "cohort" and not t.closed and t.vb == vb)
+        cap = seg_ops.bucket_size(max_tenants())
+        return min(seg_ops.bucket_size(max(1, n)), cap)
+
+    def _tuner_space(self, nb: int) -> dict:
+        """The `tenant_cohort` arm space at cohort bucket Nb:
+        tenants-per-dispatch rungs under the live bucket, plus the
+        windows-per-superbatch arm when the resident cohort tier is
+        selected (its rungs divide the GS_RESIDENT_SPB bucket)."""
+        space = {"tpd": sorted({max(1, nb // 4), max(1, nb // 2),
+                                nb})}
+        if resident_engine.resolve_resident_cohort():
+            spb = seg_ops.bucket_size(
+                resident_engine.resident_spb(self.eb))
+            space["spb"] = sorted({max(1, spb // 4),
+                                   max(1, spb // 2), spb})
+        return space
+
     def _tuner(self, vb: int):
-        """The tenants-per-dispatch arm (ops/autotune.DispatchTuner,
-        family `tenant_cohort`): pump rounds chunk ready tenants into
-        tpd-sized dispatches and feed measured edges/s back. None when
-        the tuner is disabled (GS_AUTOTUNE=0) or GS_TENANT_TPD pins."""
+        """The cohort dispatch arms (ops/autotune.DispatchTuner,
+        family `tenant_cohort`): tenants-per-dispatch (× windows-per-
+        superbatch on the resident tier); pump rounds chunk ready
+        tenants into tpd-sized dispatches and feed measured edges/s
+        back. None when the tuner is disabled (GS_AUTOTUNE=0) or
+        GS_TENANT_TPD pins.
+
+        The arm-family key includes the COHORT BUCKET Nb
+        (`tenant_cohort:eb=…:vb=…:N=…`): a tenant admitted during a
+        vertex-bucket grow changes the slab's row dimension, and a
+        grown cohort inheriting the old bucket's tenants-per-dispatch
+        optimum (with its stale EMAs) would exploit a measurement
+        taken on a different program shape — so a bucket change
+        REKEYS the family (ops/autotune.DispatchTuner.rekey:
+        incumbent and persisted-cache seed carry over only where the
+        new space sanctions them, EMAs reset)."""
         from ..ops import autotune
 
         if pinned_tpd() > 0 or not autotune.enabled():
             return None
         key = (vb,)
+        nb = self._cohort_nb(vb)
         tuner = self._tuners.get(key)
+        if tuner is not None and self._tuner_nb.get(key) == nb:
+            return tuner
+        space = self._tuner_space(nb)
+        init = {k: v[-1] for k, v in space.items()}
+        name = "tenant_cohort:eb=%d:vb=%d:N=%d" % (self.eb, vb, nb)
         if tuner is None:
-            cap = seg_ops.bucket_size(max_tenants())
-            tpds = sorted({max(1, cap // 4), max(1, cap // 2), cap})
             tuner = self._tuners[key] = autotune.DispatchTuner(
-                "tenant_cohort:eb=%d:vb=%d" % (self.eb, vb),
-                {"tpd": tpds}, {"tpd": cap})
+                name, space, init)
+        else:
+            tuner.rekey(name, space=space, initial=init)
+        self._tuner_nb[key] = nb
         return tuner
 
     def _resolve_tpd(self, vb: int, n_ready: int):
@@ -557,15 +746,33 @@ class TenantCohort:
     # ------------------------------------------------------------------
     # the pump: rounds of vmapped cohort dispatches
     # ------------------------------------------------------------------
+    def _window_ceiling(self) -> int:
+        """Windows of ONE tenant folded per dispatch: the static wc
+        on the scan tier; on the RESIDENT cohort tier the super-batch
+        depth (the GS_RESIDENT_SPB bucket, narrowed by the tuner's
+        windows-per-superbatch arm when one is live this round) — one
+        donated dispatch folds a whole super-batch per tenant instead
+        of wc windows. Chunking never changes summaries (window
+        boundaries are count-based), so the ceiling is a pure
+        throughput lever."""
+        if not resident_engine.resolve_resident_cohort():
+            return self.wc
+        spb = seg_ops.bucket_size(
+            resident_engine.resident_spb(self.eb))
+        if self._round_spb:
+            spb = min(spb, seg_ops.bucket_size(self._round_spb))
+        return max(self.wc, spb)
+
     def _take_windows(self, t: _Tenant) -> int:
         """Full windows this tenant contributes to the next slab (plus
         the final partial one once closing)."""
         if t.tier != "cohort" or t.closed:
             return 0
+        wc = self._window_ceiling()
         full = t.queued // self.eb
-        if t.closing and t.queued % self.eb and full < self.wc:
-            return min(full + 1, self.wc)
-        return min(full, self.wc)
+        if t.closing and t.queued % self.eb and full < wc:
+            return min(full + 1, wc)
+        return min(full, wc)
 
     def _prep_slab(self, batch: List[_Tenant], wins: List[int]):
         """Right-pad each tenant's next `wins` windows into the cohort
@@ -611,21 +818,46 @@ class TenantCohort:
             self._demote(t, "slab prep failed: %s" % err)
         if not real:
             return 0
-        carries = []
-        for t, _row, _w, _n in real:
-            if t.carry is None:
-                t.carry = self._fresh_carry(t.vb)
-            carries.append(t.carry)
-        by_row = {row: i for i, (_t, row, _w, _n) in enumerate(real)}
-        # pad rows (demoted-mid-prep or a non-power-of-two cohort)
-        # carry a fresh zero-stream state — built only when the slab
-        # actually has them (the steady-state full slab skips it)
-        pad = (self._fresh_carry(vb) if len(by_row) < nb else None)
-        stacked = tuple(
-            jnp.stack([carries[by_row[r]][leaf] if r in by_row
-                       else pad[leaf] for r in range(nb)])
-            for leaf in range(3))
-        run = self._program(vb, kb, nb, wb)
+        res_on = resident_engine.resolve_resident_cohort()
+        res_key = (vb, kb)
+        # the resident stack's row signature for THIS dispatch: the
+        # tenant at each slab row (None = pad row)
+        sig = [None] * nb
+        for t, row, _w, _n in real:
+            sig[row] = t.tid
+        sig = tuple(sig)
+        entry = self._res.get(res_key) if res_on else None
+        if entry is not None and entry["nb"] == nb \
+                and entry["rows"] == sig \
+                and all(t.res_row == row for t, row, _w, _n in real):
+            # the steady-state resident hit: the cohort's carries are
+            # already stacked ON DEVICE from the previous super-batch
+            # — no per-tenant restack, no h2d of N carry slabs
+            stacked = entry["carry"]
+        else:
+            # membership changed (admit/close/demote/restore) or the
+            # tier just turned on: evict the WHOLE stale stack at this
+            # key — not just this batch's tenants — so absent tenants
+            # (closing peers, drained queues) materialize their rows
+            # BEFORE the commit below replaces the stack under them;
+            # then restack from wherever each carry lives (with the
+            # tier off this also materializes rows stranded by a
+            # flipped pin — a no-op when no stack exists)
+            self._evict_resident(res_key)
+            carries = [self._carry_of(t) for t, _r, _w, _n in real]
+            by_row = {row: i
+                      for i, (_t, row, _w, _n) in enumerate(real)}
+            # pad rows (demoted-mid-prep or a non-power-of-two
+            # cohort) carry a fresh zero-stream state — built only
+            # when the slab actually has them
+            pad = (self._fresh_carry(vb) if len(by_row) < nb
+                   else None)
+            stacked = tuple(
+                jnp.stack([carries[by_row[r]][leaf] if r in by_row
+                           else pad[leaf] for r in range(nb)])
+                for leaf in range(3))
+        run = (self._res_program(vb, kb, nb, wb) if res_on
+               else self._program(vb, kb, nb, wb))
         edges = sum(n for _t, _row, _w, n in real)
 
         def _dispatch():
@@ -674,6 +906,14 @@ class TenantCohort:
             raise PoisonOutput(
                 "cohort dispatch finalized implausible analytics for "
                 "tenant(s) %s" % ", ".join(poisoned), poisoned)
+        if res_on:
+            # commit the resident stack only now, PAST the poison
+            # gate: a refused dispatch leaves the previous stack (and
+            # every per-tenant carry) untouched for the bulkhead's
+            # re-prep of the healthy remainder
+            self._res[res_key] = {"nb": nb, "rows": sig,
+                                  "carry": new_carries}
+            self.resident_dispatches += 1
         for t, row, w, n in real:
             summaries = []
             for j in range(w):
@@ -689,7 +929,14 @@ class TenantCohort:
                     "odd_cycle": bool(odd[row, j]),
                     "triangles": int(tri_w),  # gslint: disable=host-sync (numpy-on-numpy after the batched materialize)
                 })
-            t.carry = tuple(a[row] for a in new_carries)
+            if res_on:
+                # the carry stays IN the device-resident stack; the
+                # tenant keeps only its row cursor (checkpoints and
+                # demotions gather their slice via _carry_of)
+                t.res_row = row
+                t.carry = None
+            else:
+                t.carry = tuple(a[row] for a in new_carries)
             t.src = t.src[n:]
             t.dst = t.dst[n:]
             t.bp_stamped = False  # queue drained: new overflow episode
@@ -865,6 +1112,9 @@ class TenantCohort:
             self._round_no += 1
             for (vb, kb), ready in sorted(by_group.items()):
                 tpd, arm = self._resolve_tpd(vb, len(ready))
+                # the windows-per-superbatch arm (resident tier only)
+                # narrows this round's per-tenant window ceiling
+                self._round_spb = int((arm or {}).get("spb") or 0)
                 batches = [ready[i:i + tpd]
                            for i in range(0, len(ready), tpd)]
                 descs = [(b, [self._take_windows(t) for t in b])
@@ -1039,6 +1289,7 @@ class TenantCohort:
             # absorb the probe engine's state as the new last-good
             # carry (bit-exact: the engine layout IS the cohort's)
             est = t.engine.state_dict()
+            self._break_residency(t)
             t.carry = tuple(jnp.asarray(a) for a in est["carry"])
             t.src = t.src[n:]
             t.dst = t.dst[n:]
@@ -1105,6 +1356,7 @@ class TenantCohort:
         the existing cardinality-bounded tenant labels."""
         if t.tier == "quarantined":
             return
+        self._break_residency(t)  # its carry leaves the device stack
         from_tier = t.tier
         t.tier = "quarantined"
         t.engine = None
@@ -1135,6 +1387,7 @@ class TenantCohort:
     def _demote(self, t: _Tenant, reason: str) -> None:
         if t.tier == "single":
             return
+        self._break_residency(t)  # its carry leaves the device stack
         eng = scan_analytics.StreamSummaryEngine(
             edge_bucket=self.eb, vertex_bucket=t.vb, k_bucket=t.kb)
         eng.load_state_dict(self.tenant_state_dict(t.tid))
@@ -1168,8 +1421,10 @@ class TenantCohort:
                                   and t.engine is not None):
             state = t.engine.state_dict()
         else:
-            carry = (t.carry if t.carry is not None
-                     else self._fresh_carry(t.vb))
+            # _carry_of gathers the tenant's slice out of the
+            # resident stack when that tier holds it — the per-tenant
+            # checkpoint gather at super-batch boundaries
+            carry = self._carry_of(t)
             deg, labels, cover = (np.array(x) for x in carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: the tenant state_dict's one d2h)
             state = {
                 "edge_bucket": self.eb,
@@ -1214,6 +1469,10 @@ class TenantCohort:
                 "checkpoint wal_offset %d exceeds its own window "
                 "coverage (%d windows x eb=%d)" % (
                     int(woff), t.windows_done, self.eb))
+        # the checkpoint is authoritative: a tenant restored while
+        # the resident stack holds its carry leaves the stack (and
+        # the stack restacks without it next dispatch)
+        self._break_residency(t)
         t.carry = tuple(jnp.asarray(a) for a in state["carry"])
         q = state.get("quarantine")
         if q is not None:

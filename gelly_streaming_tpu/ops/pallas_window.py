@@ -111,12 +111,14 @@ _PROBES = {}  # (vb,kb,kind) -> bool probe verdict  # gslint: disable=thread-sha
 # selection gate (the resolve_* family)
 # ----------------------------------------------------------------------
 _PALLAS = None  # "pallas" | "xla", resolved once per process
+_COHORT_PALLAS = None  # "pallas" | "xla", resolved once per process
 
 
 def _reset_pallas_window() -> None:
-    """Test hook: forget the memoized selection and probe verdicts."""
-    global _PALLAS
+    """Test hook: forget the memoized selections and probe verdicts."""
+    global _PALLAS, _COHORT_PALLAS
     _PALLAS = None
+    _COHORT_PALLAS = None
     _PROBES.clear()
 
 
@@ -149,6 +151,41 @@ def resolve_pallas_window() -> bool:
                             error="%s: %s" % (type(e).__name__, e))
         _PALLAS = impl
     return _PALLAS == "pallas"
+
+
+def resolve_cohort_pallas() -> bool:
+    """Should build_cohort_scan run the TENANT-AXIS Pallas megakernel
+    (the tenant axis as a second grid dimension of one pallas_call,
+    the whole cohort's carries VMEM-resident) instead of vmapping the
+    XLA scan body over tenants? GS_COHORT_PALLAS pins (`on`/`off`);
+    unset/`auto` adopts only when committed backend-matched
+    `tenancy_ab` rows with probe `cohort_pallas` — NON-interpret rows
+    only, the interpret parity rows time nothing real — ALL show
+    exact per-tenant parity and ≥1.05× (ops/triangles.rows_clear_bar).
+    CPU cohort digests stay bit-identical until a chip row lands.
+    Memoized per process."""
+    global _COHORT_PALLAS
+    pin = knobs.get_str("GS_COHORT_PALLAS")
+    if pin == "on":
+        return True
+    if pin == "off":
+        return False
+    if _COHORT_PALLAS is None:
+        impl = "xla"
+        try:
+            perf = tri_ops._load_matching_perf()
+            rows = [r for r in (perf or {}).get("tenancy_ab", [])
+                    if r.get("probe") == "cohort_pallas"
+                    and not r.get("interpret")]
+            if tri_ops.rows_clear_bar(rows, "speedup",
+                                      lambda r: 1.0):
+                impl = "pallas"
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="cohort_pallas", fallback=impl,
+                            error="%s: %s" % (type(e).__name__, e))
+        _COHORT_PALLAS = impl
+    return _COHORT_PALLAS == "pallas"
 
 
 # ----------------------------------------------------------------------
@@ -305,6 +342,38 @@ def supports(eb: int, vb: int, kb: int, tile_e: int = None,
         return True
     return vmem_window_bytes(eb, vb, kb, tile_e, ck,
                              compact) <= VMEM_BUDGET
+
+
+def cohort_vmem_window_bytes(eb: int, vb: int, kb: int, nb: int,
+                             tile_e: int = None,
+                             ck: int = None) -> int:
+    """The TENANT-AXIS kernel's VMEM high-water estimate (DESIGN.md
+    §19's cohort term): the single-window arithmetic with the carry
+    in/out blocks multiplied by the N cohort rows held VMEM-resident
+    across the whole grid — slab scratch, the K-bucket table, and the
+    bounded compare block stay single-tenant (one tenant's final
+    stage runs at a time)."""
+    if tile_e is None or ck is None:
+        tile_e, ck = resolve_tiles(eb, kb)
+    it = min(tile_e, INTERSECT_TILE, eb)
+    slab = 2 * 4 * eb
+    carry = 2 * nb * carry_bytes(vb)
+    nbr = 4 * (vb + 1) * kb
+    compare = 2 * 4 * it * kb + it * min(ck, kb) * kb
+    sort_tmp = 6 * 4 * eb
+    return slab + carry + nbr + compare + sort_tmp
+
+
+def supports_cohort(eb: int, vb: int, kb: int, nb: int,
+                    tile_e: int = None, ck: int = None) -> bool:
+    """Does an N-row cohort at (eb, vb, kb) fit the chip's VMEM
+    budget? Same contract as supports(): enforced on TPU backends
+    only — interpret mode has no VMEM. The N-row carry term tightens
+    the kb-at-wide-vb frontier; see the DESIGN.md §19 table."""
+    if not _on_tpu():
+        return True
+    return cohort_vmem_window_bytes(eb, vb, kb, nb,
+                                    tile_e, ck) <= VMEM_BUDGET
 
 
 def register_cost_model(eb: int, vb: int, kb: int,
@@ -532,6 +601,114 @@ def _window_call(eb: int, vb: int, kb: int, tile_e: int, ck: int,
     return run
 
 
+def _cohort_call(eb: int, vb: int, kb: int, nb: int, tile_e: int,
+                 ck: int, interpret: bool):
+    """The tenant-axis megakernel pallas_call closure:
+    (deg[nb,vb1], lab[nb,vb1], cov[nb,2vb1], *wire[nb,g,tile_e]) ->
+    (deg, lab, cov, sums[nb,8]). The tenant axis is the OUTER grid
+    dimension (the edge-tile axis is last, so it iterates innermost:
+    each tenant's tiles sweep 0..g-1 before the grid advances to the
+    next tenant); the stacked carries are whole-array VMEM blocks
+    under constant index maps — the entire cohort stays VMEM-resident
+    across the grid, which is exactly the N-row carry term
+    cohort_vmem_window_bytes budgets. The slab scratch is reused per
+    tenant (tenant n's final stage consumes it at tile g-1, before
+    tenant n+1's first tile overwrites it)."""
+    key = (eb, vb, kb, nb, tile_e, ck, "n", interpret)
+    got = _CALLS.get(key)
+    if got is not None:
+        return got
+    from . import unionfind as uf
+
+    g = eb // tile_e
+    sent = vb
+    it = min(tile_e, INTERSECT_TILE, eb)
+    vb1 = vb + 1
+
+    def _row(ref, n):
+        return pl.load(ref, (pl.dslice(n, 1), slice(None)))[0]
+
+    def _set_row(ref, n, val):
+        pl.store(ref, (pl.dslice(n, 1), slice(None)), val[None])
+
+    def kernel(s_ref, d_ref, v_ref, deg0, lab0, cov0,
+               deg_ref, lab_ref, cov_ref, sums_ref, slab_s, slab_d):
+        n = pl.program_id(0)
+        i = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(n == 0, i == 0))
+        def _():
+            # one whole-cohort carry copy at the very first grid step
+            deg_ref[:] = deg0[:]
+            lab_ref[:] = lab0[:]
+            cov_ref[:] = cov0[:]
+
+        v = v_ref[0, 0, :]
+        s = jnp.where(v, s_ref[0, 0, :], sent)
+        d = jnp.where(v, d_ref[0, 0, :], sent)
+        ones = jnp.where(v, 1, 0)
+        _set_row(deg_ref, n,
+                 _row(deg_ref, n).at[s].add(ones).at[d].add(ones))
+        slab_s[i, :] = s
+        slab_d[i, :] = d
+
+        @pl.when(i == g - 1)
+        def _():
+            sa = slab_s[:].reshape(eb)
+            da = slab_d[:].reshape(eb)
+            va = sa != sent
+            lab = uf.cc_fixpoint(_row(lab_ref, n), sa, da)
+            _set_row(lab_ref, n, lab)
+            cov = uf.cc_fixpoint(
+                _row(cov_ref, n), jnp.concatenate([sa, sa + vb1]),
+                jnp.concatenate([da + vb1, da]))
+            _set_row(cov_ref, n, cov)
+            mdeg, ncomp, odd = _final_summaries(
+                vb, _row(deg_ref, n), lab, cov)
+            tri, ovf = _tri_stage(sa, da, va, vb, kb, it, ck)
+            _set_row(sums_ref, n,
+                     _pack_sums(mdeg, ncomp, jnp.where(odd, 1, 0),
+                                tri, ovf))
+
+    tile_spec = pl.BlockSpec((1, 1, tile_e), lambda n, i: (n, i, 0),
+                             memory_space=pltpu.VMEM)
+    carry_specs = [
+        pl.BlockSpec((nb, vb1), lambda n, i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((nb, vb1), lambda n, i: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((nb, 2 * vb1), lambda n, i: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    call = pl.pallas_call(
+        kernel,
+        grid=(nb, g),
+        in_specs=[tile_spec, tile_spec, tile_spec] + carry_specs,
+        out_specs=carry_specs + [
+            pl.BlockSpec((nb, _SUMS), lambda n, i: (0, 0),
+                         memory_space=pltpu.SMEM)],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb, vb1), jnp.int32),
+            jax.ShapeDtypeStruct((nb, vb1), jnp.int32),
+            jax.ShapeDtypeStruct((nb, 2 * vb1), jnp.int32),
+            jax.ShapeDtypeStruct((nb, _SUMS), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((g, tile_e), jnp.int32),
+                        pltpu.VMEM((g, tile_e), jnp.int32)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=nb * window_flops(eb, vb, kb),
+            bytes_accessed=nb * window_bytes(eb, vb, False),
+            transcendentals=0),
+    )
+
+    def run(deg, lab, cov, *wire):
+        return call(*wire, deg, lab, cov)
+
+    _CALLS[key] = run
+    return run
+
+
 def _counter_call(eb: int, vb: int, kb: int, tile_e: int, ck: int,
                   interpret: bool):
     """Triangle-only megakernel (the stream kernel's per-window body
@@ -667,6 +844,90 @@ def maybe_window_body(eb: int, vb: int, kb: int,
                                           str(e)[:200]))
         return None
     register_cost_model(eb, vb, kb, compact)
+    return body
+
+
+def build_cohort_window_body(eb: int, vb: int, kb: int, nb: int,
+                             tile_e: int = None,
+                             chunk_k: int = None,
+                             interpret: bool = None):
+    """The tenant-axis megakernel as a drop-in body for
+    scan_analytics.build_cohort_scan's window loop: body(carry, xs)
+    with the STACKED carry layout ((deg[nb,vb+1], labels[nb,vb+1],
+    cover[nb,2(vb+1)])) and per-window-round outputs of shape [nb]
+    each (max_degree, num_components, odd, triangles, K-overflow) —
+    the same pytree the vmapped XLA body produces, so the two paths
+    are interchangeable under lax.scan. Standard wire only (the
+    cohort slab is int32 src/dst + bool valid)."""
+    tile_e, ck = resolve_tiles(eb, kb, vb, tile_e, chunk_k)
+    if interpret is None:
+        interpret = _need_interpret()
+    run = _cohort_call(eb, vb, kb, nb, tile_e, ck, interpret)
+    g = eb // tile_e
+
+    def body(carry, xs):
+        deg, lab, cov = carry
+        src, dst, valid = xs
+        deg, lab, cov, sums = run(
+            deg, lab, cov, src.reshape(nb, g, tile_e),
+            dst.reshape(nb, g, tile_e),
+            valid.reshape(nb, g, tile_e))
+        return (deg, lab, cov), (sums[:, 0], sums[:, 1],
+                                 sums[:, 2] != 0, sums[:, 3],
+                                 sums[:, 4])
+
+    body.pallas_window = True
+    return body
+
+
+def maybe_cohort_body(eb: int, vb: int, kb: int, nb: int):
+    """The gated, PROBED entry build_cohort_scan builds through: None
+    (vmap the XLA body over tenants) unless resolve_cohort_pallas()
+    is on, the N-row shape fits the chip budget, AND a trace probe of
+    the built body succeeds — the same durable `selection.fallback`
+    contract as maybe_window_body, under component `cohort_pallas`.
+    On success the cohort analytic cost entry registers with the
+    observatory."""
+    if not resolve_cohort_pallas():
+        return None
+    tile_e, ck = resolve_tiles(eb, kb, vb)
+    if not supports_cohort(eb, vb, kb, nb, tile_e, ck):
+        telemetry.event("selection.fallback", durable=True,
+                        component="cohort_pallas",
+                        fallback="xla_cohort_scan",
+                        error="vmem budget: %d > %d at eb=%d vb=%d "
+                              "kb=%d nb=%d" % (
+                                  cohort_vmem_window_bytes(
+                                      eb, vb, kb, nb, tile_e, ck),
+                                  VMEM_BUDGET, eb, vb, kb, nb))
+        return None
+    try:
+        body = build_cohort_window_body(eb, vb, kb, nb, tile_e, ck)
+        vb1 = vb + 1
+        carry = (jax.ShapeDtypeStruct((nb, vb1), jnp.int32),
+                 jax.ShapeDtypeStruct((nb, vb1), jnp.int32),
+                 jax.ShapeDtypeStruct((nb, 2 * vb1), jnp.int32))
+        xs = (jax.ShapeDtypeStruct((nb, eb), jnp.int32),
+              jax.ShapeDtypeStruct((nb, eb), jnp.int32),
+              jax.ShapeDtypeStruct((nb, eb), jnp.bool_))
+        jax.eval_shape(body, carry, xs)
+    except Exception as e:
+        telemetry.event("selection.fallback", durable=True,
+                        component="cohort_pallas",
+                        fallback="xla_cohort_scan",
+                        error="%s: %s" % (type(e).__name__,
+                                          str(e)[:200]))
+        return None
+    costmodel.record_analytic(
+        "cohort_pallas", "eb=%d,vb=%d,kb=%d,nb=%d" % (eb, vb, kb, nb),
+        flops=nb * window_flops(eb, vb, kb),
+        bytes_accessed=nb * window_bytes(eb, vb, False),
+        slab_bytes=nb * slab_bytes(eb, False),
+        scan_of_gathers_bytes=nb * scan_of_gathers_bytes(eb, vb),
+        model="analytic",
+        # PER WINDOW ROUND (one window × nb tenants); a super-batch
+        # dispatch folds W of them
+        unit="window")
     return body
 
 

@@ -162,6 +162,50 @@ def resolve_resident() -> bool:
     return _RESIDENT == "resident"
 
 
+_RESIDENT_COHORT = None  # "resident" | "scan", resolved once per process
+
+
+def _reset_resident_cohort() -> None:
+    """Test hook: forget the memoized resident-cohort selection."""
+    global _RESIDENT_COHORT
+    _RESIDENT_COHORT = None
+
+
+def resolve_resident_cohort() -> bool:
+    """Should TenantCohort keep its carries stacked on DEVICE between
+    rounds (the resident cohort tier: one donated `[N, ...]` carry
+    pytree updated by one super-batch program, restacked only when
+    membership changes) instead of restacking per-tenant host-visible
+    carries every dispatch? GS_COHORT_RESIDENT pins (`on`/`off`);
+    unset/`auto` adopts only when committed backend-matched
+    `tenancy_ab` rows with probe `cohort_resident` ALL show exact
+    per-tenant parity and ≥1.05× over per-tenant resident dispatch
+    (the repo-wide measured-adoption policy,
+    ops/triangles.rows_clear_bar). Memoized per process."""
+    global _RESIDENT_COHORT
+    pin = knobs.get_str("GS_COHORT_RESIDENT")
+    if pin == "on":
+        return True
+    if pin == "off":
+        return False
+    if _RESIDENT_COHORT is None:
+        impl = "scan"
+        try:
+            perf = tri_ops._load_matching_perf()
+            rows = [r for r in (perf or {}).get("tenancy_ab", [])
+                    if r.get("probe") == "cohort_resident"]
+            if tri_ops.rows_clear_bar(
+                    rows, "tenant_edges_per_s",
+                    lambda r: r.get("sequential_edges_per_s") or 0):
+                impl = "resident"
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="resident_cohort", fallback=impl,
+                            error="%s: %s" % (type(e).__name__, e))
+        _RESIDENT_COHORT = impl
+    return _RESIDENT_COHORT == "resident"
+
+
 # ----------------------------------------------------------------------
 # ResidentState
 # ----------------------------------------------------------------------

@@ -64,9 +64,11 @@ def _build_scan(eb: int, vb: int, kb: int, pallas_ok: bool = True):
     VMEM-tiled pallas_call per window computing ALL analytics from a
     single HBM read of the edge slab, same carry layout, same
     per-window outputs, bit-identical by construction. `pallas_ok`
-    lets callers whose composition the kernel doesn't support yet opt
-    out — build_cohort_scan vmaps the body over a tenant axis, and
-    vmap-of-pallas_call stays unproven until its own chip row lands."""
+    lets callers whose composition the kernel doesn't support opt
+    out — build_cohort_scan's vmap form needs a pure-XLA body (its
+    tenant-axis Pallas variant is its own kernel with the tenant axis
+    as a grid dimension, ops/pallas_window.maybe_cohort_body, gated
+    on its own evidence)."""
     if pallas_ok:
         from . import pallas_window
 
@@ -110,8 +112,8 @@ def _build_scan(eb: int, vb: int, kb: int, pallas_ok: bool = True):
     return body
 
 
-def build_cohort_scan(eb: int, vb: int, kb: int):
-    """The multi-tenant vmap entry (core/tenancy.py): the SAME scan
+def build_cohort_scan(eb: int, vb: int, kb: int, nb: int = None):
+    """The multi-tenant cohort entry (core/tenancy.py): the SAME scan
     body as every fused summary engine, lifted over a leading tenant
     axis — carries are [N, ...] slabs, edge slabs are [N, W, eb], and
     one dispatch folds one window cohort across all N streams (the
@@ -120,11 +122,42 @@ def build_cohort_scan(eb: int, vb: int, kb: int):
     row (all-invalid windows) folds as a no-op against its carry, so
     per-tenant results are bit-identical to N separate
     StreamSummaryEngine runs — the parity contract tools/tenancy_ab.py
-    and tests/test_tenancy.py assert window by window. The cohort
-    body stays the XLA scan even when the Pallas megakernel is
-    selected for the single-stream engines (pallas_ok=False):
-    vmapping a pallas_call over the tenant axis is its own lowering
-    question, gated on its own future evidence."""
+    and tests/test_tenancy.py assert window by window.
+
+    Two lowerings, same contract:
+
+    - default: `jax.vmap` of the pure-XLA scan body over the tenant
+      axis (pallas_ok=False all the way down — a pallas_call smuggled
+      into the vmapped body would be batch-lowered, not
+      tenant-gridded).
+    - when `nb` is given AND the TENANT-AXIS Pallas megakernel
+      clears its own gate+probe (ops/pallas_window.maybe_cohort_body
+      — GS_COHORT_PALLAS pin or committed non-interpret
+      `cohort_pallas` rows), the window loop scans ONE pallas_call
+      whose second grid dimension is the tenant axis: the whole
+      cohort's carries VMEM-resident, one slab pass per window round.
+      Refusal (gate off, VMEM budget, trace probe) degrades to the
+      vmap form with a durable `selection.fallback` event — digests
+      are bit-identical either way."""
+    if nb is not None:
+        from . import pallas_window
+
+        cbody = pallas_window.maybe_cohort_body(eb, vb, kb, nb)
+        if cbody is not None:
+            def run_pallas(carries, src, dst, valid):
+                # [N, W, eb] -> [W, N, eb]: the window axis is the
+                # scan axis, the tenant axis rides into the kernel
+                xs = tuple(jnp.moveaxis(a, 0, 1)
+                           for a in (src, dst, valid))
+                carries, ys = jax.lax.scan(cbody, carries, xs)
+                # per-window outputs come back [W, N] — restore the
+                # vmap form's [N, W] leading tenant axis
+                return carries, tuple(jnp.moveaxis(y, 0, 1)
+                                      for y in ys)
+
+            run_pallas.pallas_window = True
+            return run_pallas
+
     body = _build_scan(eb, vb, kb, pallas_ok=False)
 
     def one_tenant(carry, src_w, dst_w, valid_w):

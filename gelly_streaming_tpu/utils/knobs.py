@@ -377,6 +377,25 @@ register("GS_TENANT_TPD", "int", 0, lo=0,
               "tenants-per-dispatch arm choose (all ready tenants in "
               "one vmapped dispatch with GS_AUTOTUNE=0)",
          default_text="0 (auto)")
+register("GS_COHORT_RESIDENT", "str", "", choices=("on", "off", "auto"),
+         help="pin the resident cohort tier (`core/tenancy.py`): a "
+              "donated `[N, ...]` stacked-carry super-batch program "
+              "per cohort instead of restacking carries every round; "
+              "`on` forces it, `off` never selects it; unset/`auto` "
+              "= adopt only on committed parity+≥5% "
+              "`tenancy_ab`/`cohort_resident` rows over per-tenant "
+              "resident dispatch",
+         default_text="auto")
+register("GS_COHORT_PALLAS", "str", "", choices=("on", "off", "auto"),
+         help="pin the tenant-axis Pallas cohort megakernel "
+              "(`ops/pallas_window.py`): one `pallas_call` with the "
+              "tenant axis as a second grid dimension serves the "
+              "whole cohort from VMEM; `on` forces it (interpret "
+              "mode off-TPU), `off` never selects it; unset/`auto` = "
+              "adopt only on committed non-interpret parity+≥1.05× "
+              "`tenancy_ab`/`cohort_pallas` rows — the vmapped XLA "
+              "cohort scan stands until a chip row lands",
+         default_text="auto")
 
 # durable serving front-end (utils/wal.py + core/serve.py)
 register("GS_WAL", "bool", True,

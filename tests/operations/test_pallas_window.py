@@ -428,3 +428,127 @@ def test_window_bytes_model_shapes():
     assert pw.vmem_window_bytes(1024, 512, 16) \
         < pw.vmem_window_bytes(2048, 512, 16) \
         < pw.vmem_window_bytes(2048, 1024, 32)
+
+
+# ----------------------------------------------------------------------
+# tenant-axis cohort megakernel (GS_COHORT_PALLAS)
+# ----------------------------------------------------------------------
+@pytest.fixture
+def cohort_pallas_on(monkeypatch):
+    monkeypatch.setenv("GS_COHORT_PALLAS", "on")
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+    pw._reset_pallas_window()
+    yield
+    pw._reset_pallas_window()
+
+
+def test_cohort_kernel_interpret_parity(cohort_pallas_on):
+    """The tier-1 interpret-parity pin: the tenant-axis megakernel
+    (tenant axis as a second grid dimension, whole cohort's carries
+    VMEM-resident) reproduces N sequential single-stream engines
+    exactly — ragged tails and pad rows included."""
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.ops import scan_analytics as sa
+
+    eb, vb = 256, 256
+    # the cohort program the dispatch will build must BE the kernel
+    run = sa.build_cohort_scan(eb, vb, 16, nb=4)
+    assert getattr(run, "pallas_window", False), \
+        "cohort scan did not select the tenant-axis megakernel"
+
+    streams = {}
+    for i in range(3):
+        n = 3 * eb - (17 if i == 2 else 0)
+        streams["t%d" % i] = _stream(n, 200, seed=20 + i)
+    want = {tid: StreamSummaryEngine(
+                edge_bucket=eb, vertex_bucket=vb).process(s, d)
+            for tid, (s, d) in streams.items()}
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    got = {tid: [] for tid in streams}
+    for tid in streams:
+        co.admit(tid)
+    for tid, (s, d) in streams.items():
+        co.feed(tid, s, d)
+    for tid, res in co.pump().items():
+        got[tid].extend(res)
+    for tid in streams:
+        got[tid].extend(co.close(tid))
+    assert got == want
+
+
+def test_cohort_resolve_pins(monkeypatch):
+    pw._reset_pallas_window()
+    monkeypatch.setenv("GS_COHORT_PALLAS", "on")
+    assert pw.resolve_cohort_pallas() is True
+    monkeypatch.setenv("GS_COHORT_PALLAS", "off")
+    assert pw.resolve_cohort_pallas() is False
+    monkeypatch.delenv("GS_COHORT_PALLAS")
+    pw._reset_pallas_window()
+
+
+def test_cohort_resolve_evidence_gate_ignores_interpret(monkeypatch):
+    """auto adopts only on committed NON-interpret cohort_pallas rows
+    with parity AND ≥1.05x — interpret rows are parity evidence, not
+    speed evidence, and must never flip the gate."""
+    def fake_perf(rows):
+        return lambda *a, **k: {"tenancy_ab": rows}
+
+    winning = [{"probe": "cohort_pallas", "parity": True,
+                "speedup": 1.4, "tenants": 8}]
+    interp = [dict(winning[0], interpret=True)]
+    losing = [dict(winning[0], speedup=1.01)]
+    other = [{"probe": "cohort_serving", "parity": True,
+              "speedup": 2.0, "tenants": 8}]
+    monkeypatch.delenv("GS_COHORT_PALLAS", raising=False)
+    for rows, want in ((winning, True), (interp, False),
+                       (losing, False), (other, False), ([], False)):
+        monkeypatch.setattr(tri_ops, "_load_matching_perf",
+                            fake_perf(rows))
+        pw._reset_pallas_window()
+        assert pw.resolve_cohort_pallas() is want, rows
+    pw._reset_pallas_window()
+
+
+def test_cohort_vmem_budget_scales_with_rows(monkeypatch):
+    """supports_cohort recomputes the DESIGN.md budget with N carry
+    rows in flight: a shape a single tenant affords can refuse at
+    cohort width, and refusal surfaces as a durable fallback (the
+    dispatch degrades to the vmapped XLA scan, never dies)."""
+    # interpret (off-chip): no budget, any width passes
+    assert pw.supports_cohort(8192, 8192, 16, 64)
+    monkeypatch.setattr(pw, "_on_tpu", lambda: True)
+    assert pw.supports(8192, 8192, 16)
+    assert pw.supports_cohort(8192, 8192, 16, 1)
+    # 2 * 64 * carry_bytes(8192) alone is ~16.8MB > the 12MB budget
+    assert not pw.supports_cohort(8192, 8192, 16, 64)
+    # the cohort term is exactly N stacked carries over the single row
+    assert (pw.cohort_vmem_window_bytes(8192, 8192, 16, 64)
+            - pw.cohort_vmem_window_bytes(8192, 8192, 16, 1)
+            == 2 * 63 * pw.carry_bytes(8192))
+    monkeypatch.setenv("GS_COHORT_PALLAS", "on")
+    monkeypatch.setenv("GS_TELEMETRY", "1")
+    monkeypatch.delenv("GS_TRACE_DIR", raising=False)
+    pw._reset_pallas_window()
+    telemetry.reset()
+    try:
+        assert pw.maybe_cohort_body(8192, 8192, 16, 64) is None
+        evs = [r for r in telemetry.records()
+               if r["name"] == "selection.fallback"
+               and r["a"].get("component") == "cohort_pallas"]
+        assert evs and "vmem budget" in evs[0]["a"].get("error", "")
+    finally:
+        pw._reset_pallas_window()
+        telemetry.reset()
+
+
+def test_cohort_gate_default_off_is_vmapped_scan(pallas_unset,
+                                                 monkeypatch):
+    """GS_COHORT_PALLAS unset on a backend with no committed
+    non-interpret rows: build_cohort_scan returns the vmapped XLA
+    scan, bit-identical to today's default."""
+    monkeypatch.delenv("GS_COHORT_PALLAS", raising=False)
+    from gelly_streaming_tpu.ops import scan_analytics as sa
+
+    run = sa.build_cohort_scan(256, 256, 16, nb=4)
+    assert not getattr(run, "pallas_window", False)
+    assert pw.maybe_cohort_body(256, 256, 16, 4) is None

@@ -27,7 +27,7 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_METRICS_SERIES GS_METRICS_COMPILE_BASE "
        "GS_HEALTH_STALE_S "
        "GS_TENANT_MAX GS_TENANT_QUEUE_WINDOWS GS_TENANT_ADMISSION "
-       "GS_TENANT_TPD "
+       "GS_TENANT_TPD GS_COHORT_RESIDENT GS_COHORT_PALLAS "
        "GS_WAL GS_WAL_RETAIN GS_WAL_FSYNC_S GS_WAL_SEGMENT_BYTES "
        "GS_SERVE_PORT GS_SERVE_DRAIN_S GS_SERVE_IDLE_S "
        "GS_LATENCY GS_LAT_MARKS GS_LAT_PENDING "

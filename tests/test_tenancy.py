@@ -37,13 +37,21 @@ EB, VB = 128, 256
 
 @pytest.fixture(autouse=True)
 def _clean(monkeypatch):
+    from gelly_streaming_tpu.ops import pallas_window
+    from gelly_streaming_tpu.ops import resident_engine
+
     for k in ("GS_TENANT_MAX", "GS_TENANT_QUEUE_WINDOWS",
-              "GS_TENANT_ADMISSION", "GS_TENANT_TPD", "GS_AUTOTUNE"):
+              "GS_TENANT_ADMISSION", "GS_TENANT_TPD", "GS_AUTOTUNE",
+              "GS_COHORT_RESIDENT", "GS_COHORT_PALLAS"):
         monkeypatch.delenv(k, raising=False)
     monkeypatch.setenv("GS_AUTOTUNE", "0")
     resilience.reset_demotions()
+    resident_engine._reset_resident_cohort()
+    pallas_window._reset_pallas_window()
     yield
     resilience.reset_demotions()
+    resident_engine._reset_resident_cohort()
+    pallas_window._reset_pallas_window()
 
 
 def streams_for(n, windows=4, eb=EB, vb=VB, ragged=True):
@@ -442,6 +450,200 @@ def test_tenants_per_dispatch_tuner_arm(monkeypatch, tmp_path):
     summary = tuner.summary()
     assert summary["rounds"] >= 1
     assert "tpd" in summary["chosen"]
+
+
+# ----------------------------------------------------------------------
+# cohort-aware event-time guard
+# ----------------------------------------------------------------------
+def test_event_time_interleaved_disjoint_ranges_ok():
+    """The regression the guard exists to avoid regressing INTO: two
+    tenants with disjoint, interleaved time ranges share slabs all
+    run long — monotonicity is per tenant, never per slab — and the
+    results still match the oracle exactly."""
+    streams = streams_for(2, ragged=False)
+    want = oracle(streams)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t0")
+    co.admit("t1")
+    out = {"t0": [], "t1": []}
+    piece = EB
+    # t0 lives around epoch 1_000_000, t1 around 500 — every feed
+    # round interleaves the two clocks in one admission boundary
+    for lo in range(0, 4 * EB, piece):
+        for tid, base in (("t0", 1_000_000), ("t1", 500)):
+            s, d = streams[tid]
+            if lo >= len(s):
+                continue
+            hi = min(lo + piece, len(s))
+            co.feed(tid, s[lo:hi], d[lo:hi],
+                    ts=np.arange(base + lo, base + hi, dtype=np.int64))
+        for tid, res in co.pump().items():
+            out[tid].extend(res)
+    for tid in streams:
+        out[tid].extend(co.close(tid))
+    assert out == want
+
+
+def test_event_time_regression_refuses_atomically():
+    """A per-tenant event-time regression — within a batch or against
+    the tenant's newest accepted stamp — refuses the WHOLE batch for
+    that tenant only, consuming nothing; the other tenant's clock is
+    untouched."""
+    streams = streams_for(2, ragged=False)
+    s0, d0 = streams["t0"]
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    co.admit("t0")
+    co.admit("t1")
+    # non-monotone WITHIN one batch
+    bad = np.arange(EB, dtype=np.int64)
+    bad[EB // 2] = 0
+    with pytest.raises(ValueError, match="WITHIN the batch"):
+        co.feed("t0", s0[:EB], d0[:EB], ts=bad)
+    assert co.queued_edges("t0") == 0  # nothing consumed
+    # accept a clean batch ending at ts=EB-1 ...
+    co.feed("t0", s0[:EB], d0[:EB],
+            ts=np.arange(EB, dtype=np.int64))
+    # ... then a batch starting BEFORE it: refused, naming the tenant
+    with pytest.raises(ValueError, match="t0.*already reached"):
+        co.feed("t0", s0[EB:2 * EB], d0[EB:2 * EB],
+                ts=np.arange(EB // 2, EB // 2 + EB, dtype=np.int64))
+    assert co.queued_edges("t0") == EB
+    # t1's clock is independent: far-past stamps are fine
+    s1, d1 = streams["t1"]
+    assert co.feed("t1", s1[:EB], d1[:EB],
+                   ts=np.arange(EB, dtype=np.int64)) == EB
+
+
+# ----------------------------------------------------------------------
+# resident cohort tier (GS_COHORT_RESIDENT)
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("n_tenants", [1, 3, 8])
+def test_resident_cohort_parity(monkeypatch, n_tenants):
+    """Pinned on, the donated stacked-carry super-batch program must
+    reproduce the scan-tier cohort (and thus the N sequential
+    engines) exactly — and must have actually dispatched through the
+    resident path."""
+    from gelly_streaming_tpu.ops import resident_engine
+
+    streams = streams_for(n_tenants)
+    want = oracle(streams)
+    monkeypatch.setenv("GS_COHORT_RESIDENT", "on")
+    resident_engine._reset_resident_cohort()
+    got, co = run_cohort(streams, piece=EB)
+    assert got == want
+    assert co.resident_dispatches > 0, \
+        "resident tier pinned on but never dispatched"
+
+
+def test_resident_cohort_defaults_off_digest_identical(monkeypatch):
+    """GS_COHORT_RESIDENT unset on a backend with no committed
+    cohort_resident rows clearing the bar: the dispatch plan and the
+    results are bit-identical to the scan-tier cohort."""
+    from gelly_streaming_tpu.ops import resident_engine
+
+    streams = streams_for(3)
+    base, co0 = run_cohort(streams)
+    assert co0.resident_dispatches == 0
+    monkeypatch.setenv("GS_COHORT_RESIDENT", "on")
+    resident_engine._reset_resident_cohort()
+    got, _co = run_cohort(streams)
+    assert got == base
+
+
+def test_resident_stack_replacement_never_strands_a_carry(monkeypatch):
+    """Regression pin: a membership-changed dispatch must evict the
+    WHOLE stale resident stack before committing its replacement.
+    The bug: staggered stream lengths shrink the batch (t0/t1 drain
+    first), then close(t1) dispatches a one-tenant batch whose commit
+    replaced the stack while t3 still held a res_row into it — t3's
+    final partial window then folded onto a pad row's fresh carry
+    instead of its own, silently wrong analytics."""
+    from gelly_streaming_tpu.ops import resident_engine
+
+    rng = np.random.default_rng(7)
+    streams = {}
+    for i in range(4):
+        edges = EB * (3 + i) - (EB // 3 if i % 2 else 0)
+        streams["t%d" % i] = (
+            rng.integers(0, VB, edges).astype(np.int32),
+            rng.integers(0, VB, edges).astype(np.int32))
+    want = oracle(streams)
+    monkeypatch.setenv("GS_COHORT_RESIDENT", "on")
+    resident_engine._reset_resident_cohort()
+    # piece=2*EB staggers exhaustion so the batch membership churns
+    # across rounds before the per-tenant closes cut the tails
+    got, co = run_cohort(streams, piece=2 * EB)
+    assert co.resident_dispatches > 0
+    assert got == want
+    resident_engine._reset_resident_cohort()
+
+
+def test_resolve_resident_cohort_pins_and_gate(monkeypatch):
+    from gelly_streaming_tpu.ops import resident_engine
+    from gelly_streaming_tpu.ops import triangles as tri_ops
+
+    monkeypatch.setenv("GS_COHORT_RESIDENT", "on")
+    assert resident_engine.resolve_resident_cohort() is True
+    monkeypatch.setenv("GS_COHORT_RESIDENT", "off")
+    assert resident_engine.resolve_resident_cohort() is False
+    monkeypatch.delenv("GS_COHORT_RESIDENT")
+
+    def fake_perf(rows):
+        return lambda *a, **k: {"tenancy_ab": rows}
+
+    # the committed-evidence bar: EVERY cohort_resident row parity
+    # with throughput ≥1.05x its own sequential baseline — the N=1
+    # row's honest ~1x keeps auto off
+    winning = [{"probe": "cohort_resident", "parity": True,
+                "tenants": 8, "tenant_edges_per_s": 2000,
+                "sequential_edges_per_s": 1000, "speedup": 2.0}]
+    with_n1 = winning + [
+        {"probe": "cohort_resident", "parity": True, "tenants": 1,
+         "tenant_edges_per_s": 990, "sequential_edges_per_s": 1000,
+         "speedup": 0.99}]
+    other = [{"probe": "cohort_serving", "parity": True, "tenants": 8,
+              "tenant_edges_per_s": 2000,
+              "sequential_edges_per_s": 1000, "speedup": 2.0}]
+    for rows, want in ((winning, True), (with_n1, False),
+                       (other, False), ([], False)):
+        monkeypatch.setattr(tri_ops, "_load_matching_perf",
+                            fake_perf(rows))
+        resident_engine._reset_resident_cohort()
+        assert resident_engine.resolve_resident_cohort() is want, rows
+    resident_engine._reset_resident_cohort()
+
+
+def test_tuner_rekeys_on_cohort_size_bucket(monkeypatch, tmp_path):
+    """The Nb bugfix pin: the tuner family key includes the cohort
+    size bucket, so a grown cohort gets a fresh family (stale
+    tenants-per-dispatch EMAs measured at old N can't steer the new
+    population) — and the persisted best re-seeds the new key."""
+    monkeypatch.setenv("GS_AUTOTUNE", "1")
+    monkeypatch.setenv("GS_TUNE_CACHE", str(tmp_path))
+    streams = streams_for(2, ragged=False)
+    co = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    for tid, (s, d) in streams.items():
+        co.admit(tid)
+        co.feed(tid, s[:EB], d[:EB])
+    co.pump()
+    t1 = co._tuner(VB)
+    assert t1.key.endswith(":N=8")  # bucket floor
+    assert max(t1.space["tpd"]) == 8
+    # grow the cohort past the bucket (8 → 16): the SAME cohort
+    # object must rekey its family rather than keep tuning the N=8
+    # arms on the new program shape
+    s, d = streams["t0"]
+    for i in range(10, 18):
+        co.admit("t%d" % i)
+        co.feed("t%d" % i, s[:EB], d[:EB])
+    co.pump()
+    t2 = co._tuner(VB)
+    assert t2 is t1, "rekey must mutate the family, not fork it"
+    assert t2.key.endswith(":N=16")  # bucket_size(10 live tenants)
+    assert t2 is co._tuner(VB)  # stable until the bucket moves again
+    # arms on the new family stay within ITS space
+    assert set(t2.space) >= {"tpd"}
+    assert max(t2.space["tpd"]) == 16
 
 
 # ----------------------------------------------------------------------

@@ -43,7 +43,11 @@ LIST_SECTIONS = {
     "pallas_ab": ("probe", "parity"),
     # multi-tenant cohort A/B (tools/tenancy_ab.py): N-tenant vmapped
     # dispatch vs N sequential single-tenant engines, per-tenant
-    # sha256 parity
+    # sha256 parity. Probes: cohort_serving/cohort_batch (scan tier),
+    # cohort_resident (donated stacked-carry super-batch tier, one row
+    # per N — resolve_resident_cohort's adoption evidence),
+    # cohort_pallas (tenant-axis Pallas megakernel; off-chip rows must
+    # be interpret-marked, see _check_rows)
     "tenancy_ab": ("probe", "parity", "tenants"),
     "autotune": ("engine", "parity"),
     "pipeline_stages": ("engine", "edge_bucket"),
@@ -132,6 +136,16 @@ def _check_rows(name: str, rows, errors) -> None:
                 errors.append(
                     "%s[%d]: parity-true row needs a positive "
                     "'speedup' (got %r)" % (name, i, sp))
+        if name == "tenancy_ab" \
+                and row.get("probe") == "cohort_pallas" \
+                and row.get("backend") != "tpu" \
+                and row.get("interpret") is not True:
+            # resolve_cohort_pallas ignores interpret rows for
+            # adoption; an off-chip row missing the marker would
+            # masquerade as chip speed evidence
+            errors.append(
+                "tenancy_ab[%d]: cohort_pallas row on backend %r "
+                "must carry interpret: true" % (i, row.get("backend")))
         if name == "degradations":
             ms = row.get("mesh_shape")
             if ms is not None and not (
