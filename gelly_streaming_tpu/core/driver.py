@@ -45,6 +45,7 @@ from ..ops import triangles as tri_ops
 from ..ops import unionfind
 from ..utils import checkpoint
 from ..utils import faults
+from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
 from ..utils import resilience
@@ -312,7 +313,8 @@ class StreamingAnalyticsDriver:
                  emit_deltas: bool = False,
                  snapshot_tier: str = None,
                  egress: str = None,
-                 tenant: str = None):
+                 tenant: str = None,
+                 slide: int = None):
         unknown = set(analytics) - set(self.ANALYTICS)
         if unknown:
             raise ValueError(f"unknown analytics: {sorted(unknown)}")
@@ -351,6 +353,29 @@ class StreamingAnalyticsDriver:
         self._ext_ids = np.zeros(0, np.int64)  # slot → external id cache
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.eb = seg_ops.bucket_size(edge_bucket)
+        # sliding windows via pane composition (DESIGN.md §22): the
+        # count-based path cuts PANE-sized emissions (`slide` edges)
+        # and recomputes the per-window triangle count off the composed
+        # slab of the last panes_per_window panes — each edge is folded
+        # into the cumulative analytics exactly once. slide=None (and
+        # GS_SLIDE=0) keeps the tumbling legacy path bit-identical.
+        if slide is None:
+            slide = knobs.get_int("GS_SLIDE") or 0
+        slide = int(slide) or None
+        if slide is not None:
+            if mesh is not None:
+                raise ValueError(
+                    "sliding windows are single-chip: the sharded "
+                    "engine cuts whole-window slabs across the mesh "
+                    "(compose panes upstream or drop slide=)")
+            if (seg_ops.bucket_size(slide) != slide
+                    or self.eb % slide != 0):
+                raise ValueError(
+                    "slide must be a power of two dividing the window "
+                    "size (%d), got %d" % (self.eb, slide))
+        self.slide = slide
+        self._wp = (self.eb // slide) if slide else 1
+        self._pane_ring: list = []  # last ≤ wp−1 interned (s, d) panes
         self._degrees = np.zeros(0, np.int64)
         self._deg_state = None    # device-carried degrees (single-chip)
         self._cc = np.zeros(0, np.int32)
@@ -410,6 +435,7 @@ class StreamingAnalyticsDriver:
         self.windows_done = 0
         self.edges_done = 0
         self._closed_partial = False
+        self._pane_ring = []
         self._pending_ckpt = []
         if self._ckpt_policy is not None:
             # re-anchor the cadence: the cursor just rewound to 0, so
@@ -695,6 +721,11 @@ class StreamingAnalyticsDriver:
                 faults.fire("wal_enqueue", self.tenant or "driver")
         if _starts is not None or (
                 ts is not None and len(ts) and int(np.max(ts)) >= 0):
+            if self._wp > 1:
+                raise ValueError(
+                    "sliding windows (slide=) are count-based: "
+                    "event-time streams window by window_ms (panes "
+                    "over event time need an upstream assigner)")
             if _starts is not None:
                 starts = _starts
             else:
@@ -739,11 +770,19 @@ class StreamingAnalyticsDriver:
                              t0=lat_t0)
         windows = []
         at = self.edges_done
-        for i in range(0, len(src), self.eb):
-            idx = slice(i, min(i + self.eb, len(src)))
+        cut = self._cut_size()
+        for i in range(0, len(src), cut):
+            idx = slice(i, min(i + cut, len(src)))
             windows.append((at, src[idx], dst[idx]))
             at += idx.stop - idx.start
         return self._dispatch_windows(windows, count_based=True)
+
+    def _cut_size(self) -> int:
+        """Emission granularity of the count-based path: the pane size
+        under sliding windows (each `slide`-edge pane fires one
+        emission whose triangle slab composes the ring — _window), the
+        whole edge bucket otherwise."""
+        return self.slide if self._wp > 1 else self.eb
 
     def _dispatch_windows(self, windows,
                           count_based: bool = False
@@ -752,7 +791,12 @@ class StreamingAnalyticsDriver:
         on multi-window calls (single-chip jit or shard_map over the
         mesh), the per-window path (with
         batched triangle dispatch) otherwise."""
-        batched_ok = len(windows) > 1
+        # sliding: pane emissions stay on the per-window path — the
+        # batched snapshot scan pads whole-eb slabs and its triangle
+        # stack counts the raw window, not the composed pane ring (the
+        # per-window path still batches triangle dispatches through
+        # _batched_triangles, so it is one count_windows flush per call)
+        batched_ok = len(windows) > 1 and self._wp == 1
         if batched_ok and self.mesh is not None:
             from ..parallel.mesh import shard_count
 
@@ -760,15 +804,16 @@ class StreamingAnalyticsDriver:
             # divide evenly (power-of-two buckets on power-of-two
             # meshes always do)
             batched_ok = self.eb % shard_count(self.mesh) == 0
+        cut = self._cut_size()
         with self._batched_triangles():
             if batched_ok:
                 return self._run_batched(
                     windows,
                     closes_partial=(count_based
-                                    and len(windows[-1][1]) < self.eb))
+                                    and len(windows[-1][1]) < cut))
             out = []
             for wstart, s, d in windows:
-                if count_based and len(s) < self.eb:
+                if count_based and len(s) < cut:
                     # set ONLY when the short final window is actually
                     # being emitted, so a checkpoint taken by an
                     # earlier window of this call never persists a
@@ -2111,6 +2156,13 @@ class StreamingAnalyticsDriver:
                     self._run_one_laddered(name, s, d, nv, res)
         if prev is not None:
             self._attach_host_deltas(res, prev)
+        if self._wp > 1:
+            # rotate the pane ring AFTER the analytics saw the prior
+            # panes: the next emission's triangle slab is the last
+            # wp−1 panes plus its own
+            self._pane_ring.append((np.asarray(s, np.int32).copy(),
+                                    np.asarray(d, np.int32).copy()))
+            del self._pane_ring[:-(self._wp - 1)]
         if latency.enabled():
             rec = latency.on_window(self.tenant or "driver",
                                     edges=len(src),
@@ -2260,6 +2312,11 @@ class StreamingAnalyticsDriver:
                                                           self.vb)
                 res.bipartite_odd = _snapshot_view(odd[:nv])
         elif name == "triangles":
+            # sliding: the per-window count runs on the COMPOSED slab
+            # (ring panes + this pane, ≤ eb edges) — the emission's
+            # window is the last panes_per_window panes, and triangles
+            # is the only non-cumulative analytic so only it recomputes
+            s, d = self._tri_window_edges(s, d)
             if self._tri_pending is not None:
                 # batched mode (run_arrays): defer — all of the call's
                 # windows go to the device in ONE count_windows stack
@@ -2269,6 +2326,21 @@ class StreamingAnalyticsDriver:
                     (res, np.asarray(s, np.int32), np.asarray(d, np.int32)))
             else:
                 res.triangles = self._tri_kern().count(s, d)
+
+    def _tri_window_edges(self, s: np.ndarray, d: np.ndarray):
+        """The triangle window slab of the CURRENT emission under
+        sliding windows: the ring's ≤ panes_per_window−1 prior interned
+        panes concatenated with this pane (≤ eb edges total, so the
+        full-window kernels fit unchanged). Interned slot ids are
+        stable across windows, so ring panes stay valid as the
+        vocabulary grows. Tumbling (wp == 1) passes through."""
+        if self._wp == 1 or not self._pane_ring:
+            return s, d
+        ss = [ps for ps, _pd in self._pane_ring]
+        dd = [pd for _ps, pd in self._pane_ring]
+        ss.append(np.asarray(s, np.int32))
+        dd.append(np.asarray(d, np.int32))
+        return np.concatenate(ss), np.concatenate(dd)
 
     # ------------------------------------------------------------------
     # checkpoint / resume + failure recovery (utils/checkpoint.py)
@@ -2423,6 +2495,16 @@ class StreamingAnalyticsDriver:
             "cc": self._cc.copy(),
             "bip": self._bip.copy(),
         }
+        if self._wp > 1:
+            # sliding: the pane ring rides the checkpoint so a resumed
+            # stream's next emissions compose the SAME triangle slabs
+            # the killed run would have (interned ids are stable:
+            # load re-interns vertex_ids in insertion order)
+            state["slide"] = self.slide
+            state["pane_ring_src"] = [s.copy()
+                                      for s, _d in self._pane_ring]
+            state["pane_ring_dst"] = [d.copy()
+                                      for _s, d in self._pane_ring]
         if self._engine is not None:
             # demoted mesh session: the host mirrors carried the
             # stream since the demotion — the checkpoint assembles
@@ -2465,6 +2547,18 @@ class StreamingAnalyticsDriver:
         # count-based window must refuse further unaligned feeding just
         # like the live driver would
         self._closed_partial = bool(state.get("closed_partial", False))
+        ckpt_slide = state.get("slide")
+        if (int(ckpt_slide) if ckpt_slide else None) != self.slide:
+            # pane-boundary math is governed by slide exactly as window
+            # cuts are by eb: a mismatched resume would silently shift
+            # every subsequent emission's slab — refuse loudly
+            raise ValueError(
+                "slide mismatch: checkpoint has %r, driver runs %r"
+                % (ckpt_slide, self.slide))
+        self._pane_ring = [
+            (np.asarray(s, np.int32), np.asarray(d, np.int32))
+            for s, d in zip(state.get("pane_ring_src", []),
+                            state.get("pane_ring_dst", []))]
         if "edge_bucket" in state:
             # count-based windowing is governed by eb exactly as event
             # time is by window_ms: restore it so resumed streams cut

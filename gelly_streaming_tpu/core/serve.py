@@ -83,15 +83,32 @@ from ..utils import resilience
 from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
 from ..utils.faults import InjectedFault
+from ..ops.resident_engine import Mailbox
 from .tenancy import TenantBackpressure, TenantCohort, TenantRejected
 
-_OPS = ("admit", "feed", "pump", "close", "status")
+_OPS = ("admit", "feed", "pump", "close", "status", "subscribe")
 
 
 def serve_port() -> int:
     """GS_SERVE_PORT (0 = OS-assigned ephemeral; `.port` holds the
     bound one)."""
     return knobs.get_int("GS_SERVE_PORT")
+
+
+def pump_mode() -> str:
+    """GS_PUMP: `sync` (default) pumps inline under the request lock —
+    bit-identical to the pre-pump build; `async` runs slab prep → h2d
+    → dispatch → finalize on a dedicated pump thread so ingest
+    (sanitize → WAL → enqueue, under the cohort's queue lock) overlaps
+    compute. Same digests either way — only `queue_wait` moves."""
+    return knobs.get_str("GS_PUMP")
+
+
+def sub_queue_cap() -> int:
+    """GS_SUB_QUEUE: bounded per-connection queue of the `subscribe`
+    op; a subscriber whose queue overflows is SHED (durable
+    `serve_client_shed`), never allowed to wedge the pump."""
+    return knobs.get_int("GS_SUB_QUEUE")
 
 
 def drain_deadline_s() -> float:
@@ -124,6 +141,36 @@ class StreamServer:
         # write) — ingest→deliver, not ingest→finalize
         cohort.defer_delivery = True
         self._lock = threading.RLock()
+        # --- async serving pump (GS_PUMP) lock discipline ---
+        # sync:  _ingest_lock and _pump_mutex BOTH alias _lock — every
+        #        acquisition pattern collapses to the legacy single
+        #        re-entrant lock, bit-identical behavior.
+        # async: ingest (admit/feed, socket + tails) serializes on
+        #        _ingest_lock only; the pump thread owns _pump_mutex
+        #        for prep → h2d → dispatch → finalize + _emit. The two
+        #        sides meet ONLY at the cohort's internal queue lock
+        #        (TenantCohort._qlock), so enqueue overlaps dispatch.
+        #        close/drain take _pump_mutex BEFORE _ingest_lock —
+        #        the one place both are held.
+        self.pump_mode = pump_mode()
+        if self.pump_mode == "async":
+            self._ingest_lock = threading.RLock()
+            self._pump_mutex = threading.RLock()
+        else:
+            self._ingest_lock = self._lock
+            self._pump_mutex = self._lock
+        self._pump_thread: Optional[threading.Thread] = None
+        self._pump_stop = threading.Event()
+        # bounded wake channel: feed drops a token, the pump thread
+        # wakes; a full mailbox just means the pump is already awake
+        self._pump_wake = Mailbox(capacity=64)
+        self._pump_busy = threading.Event()  # dispatch in flight
+        # result subscriptions: cid -> (conn, mailbox, tenant filter);
+        # each subscribed connection gets a sender thread draining its
+        # bounded mailbox so a slow subscriber sheds instead of
+        # stalling the pump
+        self._subs: Dict[int, tuple] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
         self._listener = socket.socket(socket.AF_INET,
                                        socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET,
@@ -150,7 +197,12 @@ class StreamServer:
                               if results_path else None)
         self.results: Dict[str, list] = {}  # tenant -> summaries
         self._stats = {"connections": 0, "requests": 0, "shed": 0,
-                       "rejections": 0, "busy": 0, "windows": 0}
+                       "rejections": 0, "busy": 0, "windows": 0,
+                       # overlap proof: ingest batches accepted WHILE
+                       # the async pump had a dispatch in flight (the
+                       # smoke gate asserts this is nonzero)
+                       "overlap_feeds": 0,
+                       "subscribers": 0, "pushed": 0}
         metrics.register_health_section("serve", self._health_section)
         telemetry.event("serve_started", port=self.port)
 
@@ -161,7 +213,54 @@ class StreamServer:
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name="gs-serve")
         self._accept_thread.start()
+        if self.pump_mode == "async" and self._pump_thread is None:
+            self._pump_thread = threading.Thread(
+                target=self._pump_loop, daemon=True,
+                name="gs-serve-pump")
+            self._pump_thread.start()
         return self
+
+    def _pump_loop(self, interval_s: float = 0.02) -> None:
+        """The dedicated pump thread (GS_PUMP=async): wake on a feed
+        token (or the interval fallback), dispatch every ready window
+        under _pump_mutex — never under _ingest_lock, so the accept
+        loop and file tails keep admitting while slabs prep, transfer
+        and compute. Rounds are bounded (max_rounds=1) so summaries
+        emit as each cohort round finalizes — an unbounded pump would
+        hold every result until the queues ran dry, turning a steady
+        arrival stream into one end-of-run delivery burst. A fatal
+        injected kill mid-pump leaves the exact SIGKILL shape the
+        chaos pump leg recovers from."""
+        while not self._pump_stop.is_set():
+            self._pump_wake.get(timeout=interval_s)
+            if self._pump_stop.is_set():
+                return
+            if not self._any_ready():
+                continue
+            try:
+                self.pump_once(max_rounds=1)
+            except InjectedFault as e:
+                if e.fatal:
+                    self.fatal = True
+                    try:
+                        self._listener.close()
+                    except OSError:
+                        pass
+                    return
+                telemetry.event("serve_pump_failed",
+                                error=repr(e)[:200])
+            except (TenantRejected, TenantBackpressure):
+                pass  # a racing close/admission: re-plan next wake
+
+    def _join_pump(self) -> None:
+        """Stop + join the async pump thread (drain/close preamble);
+        idempotent, no-op in sync mode."""
+        self._pump_stop.set()
+        self._pump_wake.close()
+        t = self._pump_thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join()
 
     def _accept_loop(self) -> None:
         while not self._draining.is_set():
@@ -246,8 +345,10 @@ class StreamServer:
         except OSError:
             return  # connection reset: the client's problem
         finally:
+            self._drop_sub(cid)
             with self._lock:
                 self._conns.pop(cid, None)
+                self._send_locks.pop(cid, None)
             metrics.gauge_set("gs_serve_active_connections",
                               len(self._conns))
             try:
@@ -262,12 +363,18 @@ class StreamServer:
         SHED (durable event + close) — the stall never reaches the
         pump, whose lock is not held here."""
         data = (json.dumps(resp) + "\n").encode()
+        # a subscribed connection has TWO writers (its request thread
+        # and its subscription sender) — one lock per connection keeps
+        # whole lines whole
+        with self._lock:
+            slock = self._send_locks.setdefault(cid, threading.Lock())
 
         def _do_send():
             from ..utils import faults
 
             faults.fire("serve_send", cid)
-            conn.sendall(data)
+            with slock:
+                conn.sendall(data)
 
         try:
             resilience.call_guarded("serve_send", cid, _do_send,
@@ -300,6 +407,7 @@ class StreamServer:
                     "message": str(e)[:500]}
         self._stats["requests"] += 1
         metrics.counter_inc("gs_serve_requests_total", op=op)
+        req["_cid"] = cid  # subscribe binds to the connection
         try:
             return getattr(self, "_op_" + op)(req)
         except TenantBackpressure as e:
@@ -351,7 +459,7 @@ class StreamServer:
                                            str(e)[:500])}
 
     def _op_admit(self, req: dict) -> dict:
-        with self._lock:
+        with self._ingest_lock:
             self.cohort.admit(req["tenant"],
                               vertex_bucket=req.get("vertex_bucket"))
         return {"ok": True, "tenant": str(req["tenant"])}
@@ -370,13 +478,23 @@ class StreamServer:
             # int32 re-cast would wrap silently into a plausible id
             src = np.asarray(req["src"], np.int32)
             dst = np.asarray(req["dst"], np.int32)
-        with self._lock:
-            accepted = self.cohort.feed(req["tenant"], src, dst)
+        ts = req.get("ts")
+        if ts is not None:
+            ts = np.asarray(ts, np.int64)
+        with self._ingest_lock:
+            if self._pump_busy.is_set():
+                # the overlap the pump exists for: this batch
+                # sanitizes/journals/enqueues WHILE a dispatch is in
+                # flight on the pump thread
+                self._stats["overlap_feeds"] += 1
+            accepted = self.cohort.feed(req["tenant"], src, dst,
+                                        ts=ts)
             self._bp_attempts.pop(str(req["tenant"]), None)
             t = self.cohort.tenants.get(str(req["tenant"]))
             rep = t.last_report if t is not None else None
             quarantined = (t is not None
                            and t.tier == "quarantined")
+        self._wake_pump()
         resp = {"ok": True, "accepted": int(accepted)}
         if rep is not None:
             # typed rejection surface: reason-code counts for the
@@ -392,8 +510,13 @@ class StreamServer:
         return {"ok": True, "results": results}
 
     def _op_close(self, req: dict) -> dict:
-        with self._lock:
-            summaries = self.cohort.close(req["tenant"])
+        # close() both flushes ingest-side state (the reorder buffer)
+        # and pumps the final windows: it must exclude BOTH sides.
+        # Lock order pump → ingest is the global one (see __init__);
+        # in sync mode each `with` re-enters the same RLock.
+        with self._pump_mutex:
+            with self._ingest_lock:
+                summaries = self.cohort.close(req["tenant"])
             out = self._emit({str(req["tenant"]): summaries}) \
                 if summaries else {}
         return {"ok": True,
@@ -402,15 +525,104 @@ class StreamServer:
     def _op_status(self, req: dict) -> dict:
         return {"ok": True, "serve": self._health_section()}
 
+    def _op_subscribe(self, req: dict) -> dict:
+        """Register THIS connection for a tenant's WindowResult rows
+        (`tenant` "*" = every stream). Rows are pushed as
+        `{"ok": true, "event": "window", ...}` lines from a dedicated
+        sender thread draining a bounded per-connection mailbox
+        (GS_SUB_QUEUE); overflow or a stalled send SHEDS the
+        subscriber via the serve_client_shed path."""
+        cid = int(req["_cid"])
+        tenant = str(req.get("tenant", "*"))
+        with self._lock:
+            conn = self._conns.get(cid)
+            if conn is None:
+                raise ValueError("subscribe on a vanished connection")
+            ent = self._subs.get(cid)
+            if ent is not None:
+                ent[2].add(tenant)
+                return {"ok": True, "subscribed": sorted(ent[2])}
+            mb = Mailbox(capacity=sub_queue_cap())
+            self._subs[cid] = (conn, mb, {tenant})
+            self._stats["subscribers"] += 1
+        threading.Thread(target=self._sub_sender_loop,
+                         args=(cid, conn, mb), daemon=True,
+                         name="gs-serve-sub-%d" % cid).start()
+        metrics.counter_inc("gs_serve_subscribes_total")
+        return {"ok": True, "subscribed": [tenant]}
+
+    def _sub_sender_loop(self, cid: int, conn, mb: Mailbox) -> None:
+        while True:
+            row = mb.get(timeout=0.5)
+            if row is None:
+                if mb.closed and not len(mb):
+                    return
+                continue
+            if not self._send(cid, conn, row):
+                self._drop_sub(cid)
+                return
+
+    def _drop_sub(self, cid: int) -> None:
+        with self._lock:
+            ent = self._subs.pop(cid, None)
+        if ent is not None:
+            ent[1].close()
+
+    def _fanout(self, rows: Dict[str, list]) -> None:
+        """Push freshly emitted rows into every matching subscriber's
+        mailbox. put() never blocks: a full mailbox means the
+        subscriber fell behind its GS_SUB_QUEUE budget — shed it
+        (durable serve_client_shed + close), the pump never waits."""
+        with self._lock:
+            subs = list(self._subs.items())
+        if not subs:
+            return
+        for cid, (conn, mb, tenants) in subs:
+            for tid, trows in rows.items():
+                if "*" not in tenants and tid not in tenants:
+                    continue
+                for row in trows:
+                    if mb.put({"ok": True, "event": "window", **row}):
+                        self._stats["pushed"] += 1
+                        continue
+                    self._stats["shed"] += 1
+                    telemetry.event("serve_client_shed", durable=True,
+                                    conn=cid, reason="sub_overflow",
+                                    depth=len(mb))
+                    metrics.counter_inc("gs_serve_shed_total")
+                    self._drop_sub(cid)
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+                    break
+                else:
+                    continue
+                break
+
     # ------------------------------------------------------------------
     # pumping & results
     # ------------------------------------------------------------------
-    def pump_once(self) -> Dict[str, list]:
-        """One cohort pump under the server lock; summaries are
-        emitted to the results sink (with per-tenant window ordinals)
-        and returned keyed by tenant."""
-        with self._lock:
-            results = self.cohort.pump()
+    def _wake_pump(self) -> None:
+        """Nudge the async pump thread (no-op in sync mode; a full
+        wake mailbox means it is already awake — drop the token)."""
+        if self.pump_mode == "async" and not self._pump_stop.is_set():
+            self._pump_wake.put(1)
+
+    def pump_once(self,
+                  max_rounds: Optional[int] = None) -> Dict[str, list]:
+        """One cohort pump under the pump mutex (the request lock in
+        sync mode); summaries are emitted to the results sink (with
+        per-tenant window ordinals) and returned keyed by tenant.
+        `max_rounds` bounds the cohort rounds per call (the async
+        pump's incremental-delivery knob); None drains every ready
+        window — the sync legacy contract."""
+        with self._pump_mutex:
+            self._pump_busy.set()
+            try:
+                results = self.cohort.pump(max_rounds=max_rounds)
+            finally:
+                self._pump_busy.clear()
             return self._emit(results)
 
     def _emit(self, results: Dict[str, list]) -> Dict[str, list]:
@@ -440,6 +652,8 @@ class StreamServer:
                 for row in rows:
                     self._results_file.write(json.dumps(row) + "\n")
                 self._results_file.flush()
+        if out:
+            self._fanout(out)
         return out
 
     def _any_ready(self) -> bool:
@@ -465,7 +679,7 @@ class StreamServer:
         tail stops at drain (its final partial line flushes first)."""
         from ..io import sources
 
-        with self._lock:
+        with self._ingest_lock:
             if str(tenant) not in self.cohort.tenants:
                 self.cohort.admit(tenant)
         stop = threading.Event()
@@ -478,8 +692,11 @@ class StreamServer:
                 d = np.asarray(d, np.int32)
                 while True:
                     try:
-                        with self._lock:
+                        with self._ingest_lock:
+                            if self._pump_busy.is_set():
+                                self._stats["overlap_feeds"] += 1
                             self.cohort.feed(tenant, s, d)
+                        self._wake_pump()
                         attempt = 0
                         break
                     except TenantBackpressure:
@@ -541,15 +758,24 @@ class StreamServer:
                 stop.set()
             for t, _stop in self._tails:
                 t.join()
+            # async pump: every ingest source is now quiet — stop the
+            # pump thread BEFORE the dry loop so exactly one pumper
+            # (this thread) runs the tail of the stream
+            self._join_pump()
             # pump the queues DRY: every window that was accepted is
             # finalized and delivered to the sink before we seal
             drained_windows = 0
             while self._any_ready():
                 drained_windows += sum(
                     len(v) for v in self.pump_once().values())
-            with self._lock:
-                self.cohort.checkpoint_all()
-                self.cohort.seal_wal()
+            # subscribers saw every drained row (fan-out runs inside
+            # _emit); close their mailboxes so sender threads exit
+            for cid in list(self._subs):
+                self._drop_sub(cid)
+            with self._pump_mutex:
+                with self._ingest_lock:
+                    self.cohort.checkpoint_all()
+                    self.cohort.seal_wal()
                 # hand the cohort back to the direct-pump shape: a
                 # cohort outliving its server must emit latency
                 # records at finalize again, and nothing still
@@ -596,7 +822,9 @@ class StreamServer:
         if self._accept_thread is None:
             self.start()
         while not self._drain_req.is_set() and not self.fatal:
-            if self._any_ready():
+            if self.pump_mode != "async" and self._any_ready():
+                # sync: the main loop IS the pump; async: the pump
+                # thread owns dispatch and this loop only waits
                 self.pump_once()
             else:
                 time.sleep(pump_interval_s)
@@ -605,6 +833,9 @@ class StreamServer:
     def close(self) -> None:
         """Hard teardown for tests (no drain semantics)."""
         self._draining.set()
+        self._join_pump()
+        for cid in list(self._subs):
+            self._drop_sub(cid)
         try:
             self._listener.close()
         except OSError:
@@ -650,6 +881,7 @@ class StreamServer:
                 if not t.closed}
         sec = {
             "port": self.port,
+            "pump": self.pump_mode,
             "draining": self._draining.is_set(),
             "active_connections": active,
             "tails": len(self._tails),
@@ -685,6 +917,8 @@ class ServeClient:
         self.sock = socket.create_connection((host, port),
                                              timeout=timeout)
         self._buf = b""
+        import collections
+        self._events = collections.deque()  # queued subscription rows
 
     def request(self, **req) -> dict:
         self.sock.sendall((json.dumps(req) + "\n").encode())
@@ -692,7 +926,13 @@ class ServeClient:
             nl = self._buf.find(b"\n")
             if nl >= 0:
                 line, self._buf = self._buf[:nl], self._buf[nl + 1:]
-                return json.loads(line)
+                resp = json.loads(line)
+                if resp.get("event") == "window":
+                    # a push raced this request's reply: keep it for
+                    # next_window(), keep reading for the reply
+                    self._events.append(resp)
+                    continue
+                return resp
             chunk = self.sock.recv(1 << 20)
             if not chunk:
                 raise ConnectionError(
@@ -703,13 +943,48 @@ class ServeClient:
     def admit(self, tenant, **kw) -> dict:
         return self.request(op="admit", tenant=tenant, **kw)
 
-    def feed(self, tenant, src, dst) -> dict:
-        return self.request(op="feed", tenant=tenant,
-                            src=np.asarray(src).tolist(),
-                            dst=np.asarray(dst).tolist())
+    def feed(self, tenant, src, dst, ts=None) -> dict:
+        req = dict(op="feed", tenant=tenant,
+                   src=np.asarray(src).tolist(),
+                   dst=np.asarray(dst).tolist())
+        if ts is not None:
+            req["ts"] = np.asarray(ts).tolist()
+        return self.request(**req)
 
     def pump(self) -> dict:
         return self.request(op="pump")
+
+    def subscribe(self, tenant="*") -> dict:
+        """Arm this connection for pushed WindowResult rows; pushes
+        that interleave with later request/response pairs are queued
+        and returned by next_window()."""
+        return self.request(op="subscribe", tenant=tenant)
+
+    def next_window(self, timeout: Optional[float] = None) -> dict:
+        """Block for the next pushed `event: window` row (queued
+        pushes first). Raises socket.timeout past `timeout`."""
+        if self._events:
+            return self._events.popleft()
+        old = self.sock.gettimeout()
+        if timeout is not None:
+            self.sock.settimeout(timeout)
+        try:
+            while True:
+                nl = self._buf.find(b"\n")
+                if nl >= 0:
+                    line, self._buf = (self._buf[:nl],
+                                       self._buf[nl + 1:])
+                    resp = json.loads(line)
+                    if resp.get("event") == "window":
+                        return resp
+                    continue  # a stale response: not ours to keep
+                chunk = self.sock.recv(1 << 20)
+                if not chunk:
+                    raise ConnectionError(
+                        "server closed the subscription")
+                self._buf += chunk
+        finally:
+            self.sock.settimeout(old)
 
     def close_tenant(self, tenant) -> dict:
         return self.request(op="close", tenant=tenant)

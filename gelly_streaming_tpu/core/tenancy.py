@@ -87,6 +87,7 @@ K-overflow recount) are inside the fused scan body itself.
 from __future__ import annotations
 
 import os
+import threading
 from typing import Dict, List, Optional
 
 import jax.numpy as jnp
@@ -140,6 +141,13 @@ def quarantine_windows() -> int:
     """GS_QUARANTINE_WINDOWS: clean solo probation windows before a
     quarantined tenant re-enters the cohort (0 = permanent)."""
     return knobs.get_int("GS_QUARANTINE_WINDOWS")
+
+
+def ooo_bound() -> int:
+    """GS_OOO_BOUND: bounded out-of-orderness (event-time ns) of the
+    per-tenant reorder buffer ahead of the monotonic guard; 0 = off
+    (ts must arrive non-decreasing exactly as before)."""
+    return knobs.get_int("GS_OOO_BOUND")
 
 
 # ----------------------------------------------------------------------
@@ -228,7 +236,7 @@ class _Tenant:
                  "tier", "engine", "ckpt_policy", "dropped_edges",
                  "bp_stamped", "fed_offset", "probation",
                  "quarantine_reason", "last_report", "res_row",
-                 "last_ts")
+                 "last_ts", "ooo_src", "ooo_dst", "ooo_ts")
 
     def __init__(self, tid: str, vb: int, kb: int):
         self.tid = tid
@@ -241,6 +249,12 @@ class _Tenant:
         self.res_row = None        # row in the resident cohort stack
                                    # (carry lives THERE, not here)
         self.last_ts = None        # newest accepted event-time stamp
+        # GS_OOO_BOUND reorder buffer: edges held (sorted by ts)
+        # until the tenant's watermark passes them — host-side, ahead
+        # of the monotonic guard, never journaled until released
+        self.ooo_src = np.zeros(0, np.int64)
+        self.ooo_dst = np.zeros(0, np.int64)
+        self.ooo_ts = np.zeros(0, np.int64)
         self.windows_done = 0
         self.closed_partial = False
         self.closing = False
@@ -321,6 +335,15 @@ class TenantCohort:
         # checkpoint_all() flush boundary, floored per tenant at the
         # older kept generation (utils/wal.RetentionCursor)
         self._wal_retention = wal_mod.RetentionCursor()
+        # the async serving pump's queue lock (GS_PUMP=async,
+        # core/serve.py): feed() appends and the pump's finalize
+        # prefix-drops under it, so ingest threads and ONE pump
+        # thread can run concurrently — the queues are append-only
+        # from feed and consume-only from pump, and every
+        # read-modify-write of (src, dst) is atomic under this lock.
+        # Under GS_PUMP=sync (the default) the lock is uncontended
+        # and the path is bit-identical to the pre-pump build.
+        self._qlock = threading.RLock()
 
     # ------------------------------------------------------------------
     # admission
@@ -353,9 +376,21 @@ class TenantCohort:
             t.ckpt_policy = checkpoint.CheckpointPolicy(
                 every_n_windows=self._ckpt_every_n,
                 every_seconds=self._ckpt_every_s)
-        self.tenants[tid] = t
+        with self._qlock:
+            # async pump: admissions land from ingest threads while
+            # the pump thread iterates its _tids() snapshot — the
+            # insert and every snapshot share the queue lock
+            self.tenants[tid] = t
         telemetry.event("tenant_admitted", tenant=tid, vb=vb)
         metrics.on_stream_start("cohort", tenant=tid)
+
+    def _tids(self) -> list:
+        """Sorted snapshot of the tenant ids — the only safe way to
+        iterate the roster once the async serving pump runs dispatch
+        on its own thread while admissions keep landing (a bare
+        sorted(self.tenants) can see the dict resize mid-iteration)."""
+        with self._qlock:
+            return sorted(self.tenants)
 
     def _tenant(self, tenant_id, for_feed: bool = False) -> _Tenant:
         tid = str(tenant_id)
@@ -460,6 +495,39 @@ class TenantCohort:
         got = faults.fire("admit", (t.tid, src, dst))
         if got is not None:
             _tid, src, dst = got
+        bound = ooo_bound()
+        if ts is not None and bound > 0:
+            # GS_OOO_BOUND reorder buffer, AHEAD of the monotonic
+            # guard: the batch merges (ts-sorted) into the tenant's
+            # host-side hold, and only the prefix the watermark
+            # (newest stamp − bound) has passed releases into the
+            # normal admission path below — the released stream is
+            # non-decreasing by construction, so the per-tenant
+            # guard keeps holding. Held edges are NOT yet accepted:
+            # not journaled, not queued, not in the return value.
+            with self._qlock:
+                src, dst, ts = self._ooo_insert(t, src, dst, ts,
+                                                bound)
+            if len(src) == 0:
+                return 0
+            try:
+                return self._feed_accepted(t, src, dst, ts, lat,
+                                           t_admit)
+            except TenantBackpressure:
+                # atomic refusal: the released prefix returns to the
+                # buffer FRONT (its stamps precede every held one),
+                # so the caller's retry re-releases it exactly
+                with self._qlock:
+                    self._ooo_unrelease(t, src, dst, ts)
+                raise
+        return self._feed_accepted(t, src, dst, ts, lat, t_admit)
+
+    def _feed_accepted(self, t: _Tenant, src, dst, ts, lat,
+                       t_admit) -> int:
+        """The admission path past the reorder buffer: monotonic
+        guard → sanitize → capacity gate → journal → enqueue. Queue
+        mutations run under _qlock so the async pump's finalize can
+        prefix-drop concurrently (GS_PUMP=async)."""
         # cohort-aware event-time guard: validated against THIS
         # tenant's clock before anything is consumed — a regression
         # refuses the batch atomically (last_ts advances only below,
@@ -501,69 +569,185 @@ class TenantCohort:
                     "tenant %r ids must be dense in [0, %d) — "
                     "out-of-range ids would scatter into another "
                     "slot's carried state" % (t.tid, t.vb))
-        capacity = queue_windows() * self.eb
-        room = capacity - t.queued
-        take = len(src)
-        if take > room:
-            durable = not t.bp_stamped  # once per overflow episode
-            t.bp_stamped = True
-            if admission_policy() == "reject":
-                raise TenantBackpressure(
-                    "tenant %r queue is full (%d queued of %d edge "
-                    "capacity; GS_TENANT_QUEUE_WINDOWS); pump() the "
-                    "cohort or retry later" % (t.tid, t.queued,
-                                               capacity),
-                    t.tid, queued=t.queued, capacity=capacity,
-                    _durable=durable)
-            take = max(0, room)
-            shed = len(src) - take
-            t.dropped_edges += shed
-            telemetry.event("tenant_rejected", durable=durable,
-                            tenant=t.tid, kind="drop", shed=shed)
-            metrics.counter_inc("gs_tenant_dropped_edges_total", shed,
-                                tenant=t.tid)
-        # the batch is now CONSUMED (fully, or drop-policy partially —
-        # either way the caller will not retry it as-is): journal the
-        # sanitizer's rejects and advance the source-offset domain.
-        # A backpressure-reject raised above commits nothing, so the
-        # retried batch journals its rejects exactly once.
-        if report is not None:
-            sanitize_mod.commit_report(report, tenant=t.tid,
-                                       origin="feed",
-                                       dlq=sanitize_mod.resolve_dlq())
-            t.fed_offset += report.accepted + report.rejected
-            t.last_report = report
-        else:
-            t.fed_offset += len(src)
-        if ts_col is not None and len(ts_col):
-            # the batch is consumed (fully, or drop-policy partially —
-            # shed edges are gone either way): this tenant's event
-            # clock advances to the batch's newest validated stamp
-            t.last_ts = int(ts_col[-1])  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
-        if take:
-            if self._wal is not None:
-                # durability boundary: the accepted edges hit the
-                # journal BEFORE the queue, so a kill anywhere past
-                # this point (including between journal append and
-                # enqueue — the wal_enqueue fault site below) is
-                # recoverable by replay; a rejected feed() journals
-                # nothing, keeping replay and the caller's view of
-                # what was accepted identical
-                self._wal.append(
-                    t.tid, src[:take], dst[:take],
-                    # admission stamp riding the ts column (int64 ns,
-                    # monotonic domain): recovery re-seeds the latency
-                    # marks with the ORIGINAL admission time
-                    np.full(take, latency.admit_ns(t_admit), np.int64)
-                    if lat else None)
-                faults.fire("wal_enqueue", t.tid)
-            t.src = np.concatenate([t.src, src[:take]])
-            t.dst = np.concatenate([t.dst, dst[:take]])
-            if lat:
-                latency.on_admit(t.tid, take, t0=t_admit)
+        # the capacity gate and the enqueue are ONE atomic section
+        # under the queue lock: a concurrent pump finalize may shrink
+        # the queue (more room, never less), and feed's append of
+        # (src, dst) must be indivisible against its prefix-drop
+        with self._qlock:
+            capacity = queue_windows() * self.eb
+            room = capacity - t.queued
+            take = len(src)
+            if take > room:
+                durable = not t.bp_stamped  # once per overflow episode
+                t.bp_stamped = True
+                if admission_policy() == "reject":
+                    raise TenantBackpressure(
+                        "tenant %r queue is full (%d queued of %d edge "
+                        "capacity; GS_TENANT_QUEUE_WINDOWS); pump() the "
+                        "cohort or retry later" % (t.tid, t.queued,
+                                                   capacity),
+                        t.tid, queued=t.queued, capacity=capacity,
+                        _durable=durable)
+                take = max(0, room)
+                shed = len(src) - take
+                t.dropped_edges += shed
+                telemetry.event("tenant_rejected", durable=durable,
+                                tenant=t.tid, kind="drop", shed=shed)
+                metrics.counter_inc("gs_tenant_dropped_edges_total",
+                                    shed, tenant=t.tid)
+            # the batch is now CONSUMED (fully, or drop-policy
+            # partially — either way the caller will not retry it
+            # as-is): journal the sanitizer's rejects and advance the
+            # source-offset domain. A backpressure-reject raised above
+            # commits nothing, so the retried batch journals its
+            # rejects exactly once.
+            if report is not None:
+                sanitize_mod.commit_report(
+                    report, tenant=t.tid, origin="feed",
+                    dlq=sanitize_mod.resolve_dlq())
+                t.fed_offset += report.accepted + report.rejected
+                t.last_report = report
+            else:
+                t.fed_offset += len(src)
+            if ts_col is not None and len(ts_col):
+                # the batch is consumed (fully, or drop-policy
+                # partially — shed edges are gone either way): this
+                # tenant's event clock advances to the batch's newest
+                # validated stamp
+                t.last_ts = int(ts_col[-1])  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+            if take:
+                if self._wal is not None:
+                    # durability boundary: the accepted edges hit the
+                    # journal BEFORE the queue, so a kill anywhere past
+                    # this point (including between journal append and
+                    # enqueue — the wal_enqueue fault site below) is
+                    # recoverable by replay; a rejected feed() journals
+                    # nothing, keeping replay and the caller's view of
+                    # what was accepted identical
+                    self._wal.append(
+                        t.tid, src[:take], dst[:take],
+                        # admission stamp riding the ts column (int64
+                        # ns, monotonic domain): recovery re-seeds the
+                        # latency marks with the ORIGINAL admission
+                        # time
+                        np.full(take, latency.admit_ns(t_admit),
+                                np.int64)
+                        if lat else None)
+                    faults.fire("wal_enqueue", t.tid)
+                t.src = np.concatenate([t.src, src[:take]])
+                t.dst = np.concatenate([t.dst, dst[:take]])
+                if lat:
+                    latency.on_admit(t.tid, take, t0=t_admit)
         metrics.gauge_set("gs_tenant_queue_edges", t.queued,
                           tenant=t.tid)
         return take
+
+    # ------------------------------------------------------------------
+    # GS_OOO_BOUND reorder buffer (event-time groundwork)
+    # ------------------------------------------------------------------
+    def _ooo_insert(self, t: _Tenant, src, dst, ts, bound: int):
+        """Merge one batch into the tenant's ts-sorted hold and peel
+        off the releasable prefix: everything at or before the
+        watermark (newest stamp seen − bound). Caller holds _qlock.
+        Raises ValueError (buffer untouched, nothing consumed) on a
+        misaligned column or an edge older than the already-released
+        frontier — with the buffer armed, "too late" means BEYOND the
+        bound, not merely out of order."""
+        src = np.asarray(src)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+        dst = np.asarray(dst)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+        col = np.asarray(ts, np.int64)  # gslint: disable=host-sync (host-input normalization: feed() takes numpy/lists, never device values)
+        if len(src) != len(dst) or col.shape != (len(src),):
+            raise ValueError(
+                "tenant %r src/dst/ts length mismatch (%d/%d/%d)"
+                % (t.tid, len(src), len(dst), col.size))
+        if col.size and t.last_ts is not None \
+                and int(col.min()) < t.last_ts:  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+            raise ValueError(
+                "tenant %r event-time regression past the "
+                "GS_OOO_BOUND=%d horizon: batch reaches back to %d "
+                "but the watermark already released through %d"
+                % (t.tid, bound, int(col.min()), t.last_ts))  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+        m_src = np.concatenate([t.ooo_src, src.astype(np.int64)])
+        m_dst = np.concatenate([t.ooo_dst, dst.astype(np.int64)])
+        m_ts = np.concatenate([t.ooo_ts, col])
+        order = np.argsort(m_ts, kind="stable")
+        m_src, m_dst, m_ts = m_src[order], m_dst[order], m_ts[order]
+        if m_ts.size:
+            wm = int(m_ts[-1]) - bound  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+            k = int(np.searchsorted(m_ts, wm, side="right"))  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+        else:
+            k = 0
+        t.ooo_src, t.ooo_dst, t.ooo_ts = (m_src[k:], m_dst[k:],
+                                          m_ts[k:])
+        self._note_watermark(t)
+        return m_src[:k], m_dst[:k], m_ts[:k]
+
+    def _ooo_unrelease(self, t: _Tenant, src, dst, ts) -> None:
+        """Return a refused released prefix to the buffer front (its
+        stamps precede every held one, so sort order is preserved).
+        Caller holds _qlock."""
+        t.ooo_src = np.concatenate([np.asarray(src, np.int64),  # gslint: disable=host-sync (the reorder hold is host numpy, never device values)
+                                    t.ooo_src])
+        t.ooo_dst = np.concatenate([np.asarray(dst, np.int64),  # gslint: disable=host-sync (the reorder hold is host numpy, never device values)
+                                    t.ooo_dst])
+        t.ooo_ts = np.concatenate([np.asarray(ts, np.int64),  # gslint: disable=host-sync (the reorder hold is host numpy, never device values)
+                                   t.ooo_ts])
+        self._note_watermark(t)
+
+    def _note_watermark(self, t: _Tenant) -> None:
+        """Report the tenant's TRUE event-time watermark lag to the
+        latency plane (seconds between the newest stamp seen and the
+        oldest edge still held), repointing the per-tenant age gauge
+        while the reorder buffer is armed. Caller holds _qlock."""
+        if t.ooo_ts.size:
+            high = max(int(t.ooo_ts[-1]),  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+                       t.last_ts if t.last_ts is not None else 0)
+            lag = max(0.0, (high - int(t.ooo_ts[0])) / 1e9)  # gslint: disable=host-sync (numpy-on-numpy: the admission-boundary event-time check)
+        else:
+            lag = 0.0
+        latency.note_watermark(t.tid, lag, held=int(t.ooo_ts.size))
+
+    def _ooo_flush(self, t: _Tenant) -> List[dict]:
+        """Release the tenant's ENTIRE hold regardless of watermark —
+        the close() boundary: a final window must not strand edges
+        the bound never passed. Feeds in capacity-sized slices,
+        pumping this tenant between slices when the queue is full;
+        returns any summaries those interleaved pumps finalized."""
+        out: List[dict] = []
+        lat = latency.enabled()
+        while t.ooo_ts.size:
+            with self._qlock:
+                room = max(0, queue_windows() * self.eb - t.queued)
+                k = min(room, int(t.ooo_ts.size))
+                src, dst, col = (t.ooo_src[:k], t.ooo_dst[:k],
+                                 t.ooo_ts[:k])
+                t.ooo_src = t.ooo_src[k:]
+                t.ooo_dst = t.ooo_dst[k:]
+                t.ooo_ts = t.ooo_ts[k:]
+                self._note_watermark(t)
+            if k:
+                try:
+                    self._feed_accepted(
+                        t, src, dst, col, lat,
+                        latency.clock() if lat else 0.0)
+                except TenantBackpressure:
+                    # a concurrent feeder filled the queue between the
+                    # room check and the enqueue: put the slice back
+                    # and drain below
+                    with self._qlock:
+                        self._ooo_unrelease(t, src, dst, col)
+            if t.ooo_ts.size:
+                # queue full: drain this tenant's full windows (the
+                # queue capacity is ≥ one window, so progress is
+                # guaranteed for a pumpable tenant) and keep flushing
+                before = t.queued
+                out.extend(self.pump(only=t.tid).get(t.tid, []))
+                if k == 0 and t.queued >= before:
+                    # nothing drains (a permanently quarantined
+                    # tenant): stop — the hold stays buffered rather
+                    # than spinning; close() still cuts the queue
+                    break
+        return out
 
     # ------------------------------------------------------------------
     # cohort programs / carries
@@ -792,12 +976,18 @@ class TenantCohort:
         for row, (t, w) in enumerate(zip(batch, wins)):
             try:
                 faults.fire("tenant_prep", t.tid)
-                n = min(w * self.eb, t.queued)
+                # a consistent (src, dst) snapshot under the queue
+                # lock: concurrent feeds only APPEND (atomically, both
+                # arrays under _qlock), so the prefix this slab packs
+                # is stable — the pump is the sole consumer
+                with self._qlock:
+                    n = min(w * self.eb, t.queued)
+                    t_src, t_dst = t.src, t.dst
                 flat_s = s[row].reshape(-1)
                 flat_d = d[row].reshape(-1)
                 flat_v = valid[row].reshape(-1)
-                flat_s[:n] = t.src[:n]
-                flat_d[:n] = t.dst[:n]
+                flat_s[:n] = t_src[:n]
+                flat_d[:n] = t_dst[:n]
                 flat_v[:n] = True
                 real.append((t, row, w, n))
             except faults.InjectedFault as e:
@@ -937,9 +1127,10 @@ class TenantCohort:
                 t.carry = None
             else:
                 t.carry = tuple(a[row] for a in new_carries)
-            t.src = t.src[n:]
-            t.dst = t.dst[n:]
-            t.bp_stamped = False  # queue drained: new overflow episode
+            with self._qlock:
+                t.src = t.src[n:]
+                t.dst = t.dst[n:]
+                t.bp_stamped = False  # queue drained: new episode
             if st is not None:
                 # per-window ingest→deliver record: join each window
                 # back to the admission mark of its completing edge;
@@ -1093,7 +1284,7 @@ class TenantCohort:
             self._pump_singles(out, staged, only=only)
             probed = self._pump_probation(out, staged, only=only)
             by_group: Dict[tuple, list] = {}
-            for tid in sorted(self.tenants):
+            for tid in self._tids():
                 if only is not None and tid != only:
                     continue
                 t = self.tenants[tid]
@@ -1193,7 +1384,7 @@ class TenantCohort:
         engine — per-tenant dispatches, identical summaries. The
         engine marks the global health plane itself; the cohort adds
         the per-tenant row."""
-        for tid in sorted(self.tenants):
+        for tid in self._tids():
             if only is not None and tid != only:
                 continue
             t = self.tenants[tid]
@@ -1203,20 +1394,22 @@ class TenantCohort:
             # contract: mirror the cohort's delivery deferral per
             # pump (serve restores defer_delivery=False at drain)
             t.engine._lat_defer = self.defer_delivery
-            n = (t.queued // self.eb) * self.eb
-            if t.closing:
-                n = t.queued
+            with self._qlock:
+                n = (t.queued // self.eb) * self.eb
+                if t.closing:
+                    n = t.queued
+                src, dst = t.src[:n], t.dst[:n]
             if n == 0:
                 if t.closing:
                     t.closed = True
                 continue
-            src, dst = t.src[:n], t.dst[:n]
             with telemetry.span("tenant.single", tenant=t.tid,
                                 edges=int(n)):
                 summaries = t.engine.process(src, dst)
-            t.src = t.src[n:]
-            t.dst = t.dst[n:]
-            t.bp_stamped = False  # queue drained: new overflow episode
+            with self._qlock:
+                t.src = t.src[n:]
+                t.dst = t.dst[n:]
+                t.bp_stamped = False  # queue drained: new episode
             t.windows_done = t.engine.windows_done
             t.closed_partial = t.engine._closed_partial
             if t.closing and t.queued == 0:
@@ -1244,14 +1437,15 @@ class TenantCohort:
         if qw <= 0:
             return 0  # permanent quarantine: truly suspended
         done = 0
-        for tid in sorted(self.tenants):
+        for tid in self._tids():
             if only is not None and tid != only:
                 continue
             t = self.tenants[tid]
             if t.tier != "quarantined" or t.closed:
                 continue
-            n = (self.eb if t.queued >= self.eb
-                 else (t.queued if t.closing else 0))
+            with self._qlock:
+                n = (self.eb if t.queued >= self.eb
+                     else (t.queued if t.closing else 0))
             if n == 0:
                 if t.closing:
                     t.closed = True
@@ -1265,7 +1459,8 @@ class TenantCohort:
                 eng._lat_admit = False
                 t.engine = eng
             t.engine._lat_defer = self.defer_delivery
-            src, dst = t.src[:n], t.dst[:n]
+            with self._qlock:
+                src, dst = t.src[:n], t.dst[:n]
             try:
                 with telemetry.span("tenant.probation", tenant=t.tid,
                                     edges=int(n)):
@@ -1291,9 +1486,10 @@ class TenantCohort:
             est = t.engine.state_dict()
             self._break_residency(t)
             t.carry = tuple(jnp.asarray(a) for a in est["carry"])
-            t.src = t.src[n:]
-            t.dst = t.dst[n:]
-            t.bp_stamped = False
+            with self._qlock:
+                t.src = t.src[n:]
+                t.dst = t.dst[n:]
+                t.bp_stamped = False
             t.windows_done = t.engine.windows_done
             t.closed_partial = t.engine._closed_partial
             if t.closing and t.queued == 0:
@@ -1337,12 +1533,16 @@ class TenantCohort:
         t = self._tenant(tenant_id)
         if t.closed:
             return []
+        # event-time hold flush: edges the GS_OOO_BOUND watermark
+        # never passed release NOW (in ts order) — the final window
+        # must not strand them
+        early = self._ooo_flush(t) if t.ooo_ts.size else []
         t.closing = True
         if t.queued == 0 and t.tier == "cohort":
             t.closed = True
-            return []
+            return early
         out = self.pump(only=t.tid)
-        return out.get(t.tid, [])
+        return early + out.get(t.tid, [])
 
     # ------------------------------------------------------------------
     # quarantine (the bulkhead's suspended state)
@@ -1378,7 +1578,7 @@ class TenantCohort:
 
     def quarantined(self) -> List[str]:
         """Currently quarantined tenant ids (the /healthz cell)."""
-        return [tid for tid in sorted(self.tenants)
+        return [tid for tid in self._tids()
                 if self.tenants[tid].tier == "quarantined"]
 
     # ------------------------------------------------------------------
@@ -1500,7 +1700,7 @@ class TenantCohort:
         return {
             "edge_bucket": self.eb,
             "tenants": {tid: self.tenant_state_dict(tid)
-                        for tid in sorted(self.tenants)},
+                        for tid in self._tids()},
         }
 
     def load_state_dict(self, state: dict) -> None:
@@ -1595,8 +1795,9 @@ class TenantCohort:
             t = self.tenants.get(tid)
             if t is None or t.closed:
                 continue
-            t.src = np.concatenate([t.src, src])
-            t.dst = np.concatenate([t.dst, dst])
+            with self._qlock:
+                t.src = np.concatenate([t.src, src])
+                t.dst = np.concatenate([t.dst, dst])
             # re-seed the latency plane's admission marks with the
             # journaled ORIGINAL stamps: the replayed windows report
             # their honest, larger latency, never reset-to-zero
@@ -1621,7 +1822,7 @@ class TenantCohort:
         if self._ckpt_dir is None:
             return 0
         saved = 0
-        for tid in sorted(self.tenants):
+        for tid in self._tids():
             t = self.tenants[tid]
             checkpoint.save(self._ckpt_path(tid),
                             self.tenant_state_dict(tid))
@@ -1667,7 +1868,7 @@ class TenantCohort:
     def resume_all(self) -> Dict[str, bool]:
         """try_resume every admitted tenant; {tenant: resumed}."""
         return {tid: self.try_resume(tid)
-                for tid in sorted(self.tenants)}
+                for tid in self._tids()}
 
     def resume_offset(self, tenant_id) -> int:
         """Edges already folded into the tenant's carried state (the

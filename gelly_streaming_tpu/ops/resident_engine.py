@@ -338,6 +338,71 @@ class IngestRing:
 
 
 # ----------------------------------------------------------------------
+# Mailbox
+# ----------------------------------------------------------------------
+class Mailbox:
+    """Small bounded thread-safe mailbox — the hand-off primitive of
+    the async serving pump (core/serve.py). Two uses there: the
+    ingest→pump wake channel (feed() posts, the pump thread blocks in
+    `get`) and the per-connection `subscribe` delivery queues (the
+    pump posts WindowResult rows, the connection thread drains; a
+    full queue returns False from put() so the slow subscriber is
+    SHED instead of wedging the pump — the same never-block-the-pump
+    contract as GS_SERVE_IDLE_S).
+
+    `close()` wakes every blocked `get` permanently (they return
+    None); items already queued still drain first. All methods are
+    safe from any thread."""
+
+    def __init__(self, capacity: int = 256):
+        import threading
+        from collections import deque
+
+        self.capacity = max(1, int(capacity))
+        self._q = deque()
+        self._cv = threading.Condition()
+        self._closed = False
+        self.dropped = 0  # put() refusals (the shed counter's feed)
+
+    def __len__(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        with self._cv:
+            return self._closed
+
+    def put(self, item) -> bool:
+        """Enqueue without ever blocking: False when the mailbox is
+        full or closed (the caller owns the shed)."""
+        with self._cv:
+            if self._closed or len(self._q) >= self.capacity:
+                self.dropped += 1
+                return False
+            self._q.append(item)
+            self._cv.notify()
+            return True
+
+    def get(self, timeout: Optional[float] = None):
+        """Dequeue one item, blocking up to `timeout` seconds (forever
+        when None). Returns None on timeout or when the mailbox was
+        closed and drained."""
+        with self._cv:
+            while not self._q:
+                if self._closed:
+                    return None
+                if not self._cv.wait(timeout):
+                    return None
+            return self._q.popleft()
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+
+# ----------------------------------------------------------------------
 # ResidentSummaryEngine
 # ----------------------------------------------------------------------
 class ResidentSummaryEngine(scan_analytics.StreamSummaryEngine):

@@ -151,7 +151,8 @@ class WindowedEdgeReduce:
 
     def __init__(self, vertex_bucket: int, edge_bucket: int,
                  name: str = "sum", direction: str = "out",
-                 fn=None, ingress: str = None, egress: str = None):
+                 fn=None, ingress: str = None, egress: str = None,
+                 slide: int = None):
         if direction not in _DIRECTIONS:
             raise ValueError(f"direction must be one of {_DIRECTIONS}")
         if egress not in (None, "full", "delta"):
@@ -159,6 +160,26 @@ class WindowedEdgeReduce:
         if fn is not None:
             name = None
         assert name in (None, "sum", "min", "max"), name
+        # sliding windows by pane composition: panes are monoid
+        # summaries, so each edge folds into its slide-sized pane ONCE
+        # and every emission composes the last panes_per_window pane
+        # (cells, counts) pairs — O(1) panes per edge instead of the
+        # naive twin's O(panes_per_window) refolds of the overlap
+        if slide is not None and int(slide) != 0:
+            slide = int(slide)
+            if name is None:
+                raise ValueError(
+                    "slide= needs a monoid name (sum/min/max): pane "
+                    "composition relies on the named identity fills")
+            eb_n = seg_ops.bucket_size(edge_bucket)
+            if (slide <= 0 or slide > eb_n or eb_n % slide
+                    or slide & (slide - 1)):
+                raise ValueError(
+                    "slide must be a power of two dividing the "
+                    "window size (%d), got %d" % (eb_n, slide))
+            self.slide = slide
+        else:
+            self.slide = None
         self.vb = seg_ops.bucket_size(vertex_bucket)
         self.eb = seg_ops.bucket_size(edge_bucket)
         # compile-size cap on the tunneled chip: its own program class
@@ -203,6 +224,76 @@ class WindowedEdgeReduce:
 
         self.stage_timers = _ip.StageTimers()
         self._fns = {}
+        # sliding mode: the inner pane engine (this engine at
+        # edge_bucket=slide — every tier decision re-resolves at the
+        # pane bucket) and the tumbling refold twin, built lazily
+        self.panes_per_window = (self.eb // self.slide
+                                 if self.slide else 1)
+        self._pane_engine = None
+        self._full_engine = None
+
+    # ---- sliding windows (pane composition) ---------------------------
+
+    def _monoid_op(self):
+        return {"sum": np.add, "min": np.minimum,
+                "max": np.maximum}[self.name]
+
+    def _pane_eng(self) -> "WindowedEdgeReduce":
+        if self._pane_engine is None:
+            self._pane_engine = WindowedEdgeReduce(
+                self.vb, self.slide, name=self.name,
+                direction=self.direction)
+        return self._pane_engine
+
+    def _full_eng(self) -> "WindowedEdgeReduce":
+        if self._full_engine is None:
+            self._full_engine = WindowedEdgeReduce(
+                self.vb, self.eb, name=self.name,
+                direction=self.direction)
+        return self._full_engine
+
+    def _compose_panes(self, panes: List[Tuple[np.ndarray,
+                                               np.ndarray]]
+                       ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """One emission per pane: emission i composes panes
+        [max(0, i-wp+1), i] — cells under the monoid ufunc (identity
+        fills are true identities, so untouched cells stay untouched),
+        counts by sum. Head-of-stream emissions compose fewer panes
+        (growing windows), the ragged tail pane is just a smaller
+        pane: both fall out of the same composition."""
+        wp = self.panes_per_window
+        op = self._monoid_op()
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        for i in range(len(panes)):
+            cells, counts = panes[i]
+            cells, counts = cells.copy(), counts.copy()
+            for c2, n2 in panes[max(0, i - wp + 1):i]:
+                op(cells, c2, out=cells)
+                counts += n2
+            out.append((cells, counts))
+        return out
+
+    def process_stream_naive(self, src, dst, val
+                             ) -> List[Tuple[np.ndarray, np.ndarray]]:
+        """The refold twin of the sliding path (parity oracle + A/B
+        baseline, tools/pump_ab.py): every emission re-reduces its
+        FULL window slice through the tumbling engine — each edge is
+        folded up to panes_per_window times. Bit-identical emissions
+        to the pane path for integer monoids."""
+        if self.slide is None:
+            return self.process_stream(src, dst, val)
+        src = np.asarray(src)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+        dst = np.asarray(dst)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+        val = np.asarray(val)  # gslint: disable=host-sync (host-input normalization: callers pass numpy/lists, never device values)
+        n = len(src)
+        out: List[Tuple[np.ndarray, np.ndarray]] = []
+        eng, s = self._full_eng(), self.slide
+        for i in range(-(-n // s)):
+            lo = max(0, (i + 1) * s - self.eb)
+            hi = min((i + 1) * s, n)
+            out.extend(eng.process_stream(src[lo:hi], dst[lo:hi],
+                                          val[lo:hi]))
+        return out
 
     # ---- jitted stack program (monoid tier) ---------------------------
 
@@ -326,6 +417,19 @@ class WindowedEdgeReduce:
         n = len(src0)
         if n == 0:
             return []
+        if self.slide is not None:
+            # sliding: fold each edge into its pane once (the inner
+            # engine at edge_bucket=slide, whatever tier it resolves
+            # to), compose panes per emission on the host — one
+            # (cells, counts) pair per slide-sized emission
+            from ..utils import telemetry as _tm
+
+            with _tm.span("reduce.sliding", monoid=self.name,
+                          edges=n, slide=self.slide,
+                          panes_per_window=self.panes_per_window):
+                panes = self._pane_eng().process_stream(src0, dst0,
+                                                        val)
+                return self._compose_panes(panes)
         from ..utils import telemetry
 
         if self.name is not None:
@@ -695,4 +799,26 @@ def numpy_reference(src, dst, val, eb: int, direction: str = "out",
             op.at(acc, vtx, vv)
             np.add.at(cnt, vtx, 1)
         out.append((acc, cnt))
+    return out
+
+
+def sliding_numpy_reference(src, dst, val, eb: int, slide: int,
+                            direction: str = "out", name: str = "sum"
+                            ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Independent sliding oracle: one emission per completed (or
+    final ragged) pane, each a FULL per-edge refold of its window
+    slice [max(0, (i+1)·slide − eb), (i+1)·slide) through
+    numpy_reference — no pane machinery shared with the engine under
+    test. Arrays are sized by the slice's max vertex id (compare
+    cells under the counts mask, like numpy_reference)."""
+    src = np.asarray(src)  # gslint: disable=host-sync (pure-host oracle: numpy on numpy)
+    dst = np.asarray(dst)  # gslint: disable=host-sync (pure-host oracle: numpy on numpy)
+    val = np.asarray(val)  # gslint: disable=host-sync (pure-host oracle: numpy on numpy)
+    n = len(src)
+    out: List[Tuple[np.ndarray, np.ndarray]] = []
+    for i in range(-(-n // slide)):
+        lo = max(0, (i + 1) * slide - eb)
+        hi = min((i + 1) * slide, n)
+        out.extend(numpy_reference(src[lo:hi], dst[lo:hi],
+                                   val[lo:hi], eb, direction, name))
     return out

@@ -518,6 +518,43 @@ register("GS_MAX_BATCH_EDGES", "int", 0, lo=0,
               "0 (default) = unbounded",
          default_text="0 (unbounded)")
 
+# async serving pump, sliding windows & event time
+# (core/serve.py + core/tenancy.py + ops/windowed_reduce.py +
+#  ops/scan_analytics.py + core/driver.py)
+register("GS_PUMP", "str", "sync", choices=("sync", "async"),
+         help="serving pump mode (`core/serve.StreamServer`): `sync` "
+              "(default) pumps inline under the request lock — "
+              "bit-identical to the pre-pump build; `async` runs slab "
+              "prep → h2d → dispatch → finalize on a dedicated pump "
+              "thread so the accept loop and file tails only "
+              "sanitize → journal → enqueue under the queue lock "
+              "(ingest overlaps compute; same digests, honest "
+              "`queue_wait` attribution)",
+         default_text="sync")
+register("GS_SLIDE", "int", 0, lo=0,
+         help="sliding-window slide in edges for the windowing "
+              "engines/driver (`slide=` default): the window advances "
+              "by this many edges per emission, each edge folds into "
+              "its pane ONCE and `window/slide` pane summaries "
+              "compose per emission; must be a power of two dividing "
+              "the window size; 0 (default) = tumbling "
+              "(slide == window)",
+         default_text="0 (tumbling)")
+register("GS_OOO_BOUND", "int", 0, lo=0,
+         help="bounded out-of-orderness (event-time ns) of the "
+              "per-tenant reorder buffer ahead of the monotonic "
+              "guard: a `feed(ts=)` edge is held until the tenant's "
+              "watermark (newest stamp − bound) passes it, then "
+              "released in ts order; 0 (default) = off — ts must "
+              "arrive non-decreasing exactly as before",
+         default_text="0 (off)")
+register("GS_SUB_QUEUE", "int", 256, lo=1,
+         help="bounded per-connection queue (WindowResult rows) of "
+              "the serve wire protocol's `subscribe` op; a "
+              "subscriber whose queue overflows is SHED with the "
+              "durable `serve_client_shed` event, never wedging the "
+              "pump")
+
 # program cost observatory (utils/costmodel.py)
 register("GS_COSTMODEL", "bool", False,
          help="arm the program cost observatory "

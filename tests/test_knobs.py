@@ -34,6 +34,7 @@ ALL = ("GS_PIPELINE_WORKERS GS_PIPELINE_INFLIGHT GS_STREAM_PREFETCH "
        "GS_SLO_P99_S GS_SLO_BUDGET GS_SLO_WINDOW_S GS_SLO_BURN "
        "GS_SANITIZE GS_DLQ_DIR GS_DLQ_RETAIN "
        "GS_QUARANTINE_WINDOWS GS_MAX_BATCH_EDGES "
+       "GS_PUMP GS_SLIDE GS_OOO_BOUND GS_SUB_QUEUE "
        "GS_COSTMODEL GS_COSTMODEL_PEAK_GFLOPS "
        "GS_COSTMODEL_PEAK_GBPS").split()
 

@@ -1,0 +1,358 @@
+"""Async serving pump suite (ISSUE 18 tentpole a) + satellites:
+
+- digest parity: the async pump (GS_PUMP=async, dedicated dispatch
+  thread) emits exactly the sync oracle's summaries, per tenant;
+- overlap: feeds accepted while a dispatch is in flight are counted
+  (the pump_smoke gate's in-suite twin, forced deterministic here by
+  hanging one dispatch);
+- races: concurrent feeders x pump thread x close/drain, with an
+  injected mid-pump fault — nothing lost, nothing doubled;
+- default pin: GS_PUMP unset keeps the single-lock legacy path (no
+  pump thread, both serve locks alias the legacy lock);
+- subscribe: pushed `event: window` rows in emission order, bounded
+  per-connection queue, slow-subscriber shed via serve_client_shed;
+- GS_OOO_BOUND reorder buffer: within-bound release, beyond-bound
+  atomic refusal, close() flushes the hold, true watermark lag in the
+  latency plane.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.serve import ServeClient, StreamServer
+from gelly_streaming_tpu.core.tenancy import TenantCohort
+from gelly_streaming_tpu.utils import faults
+from gelly_streaming_tpu.utils import latency
+
+EB, VB = 256, 512
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("GS_PUMP", "GS_SUB_QUEUE", "GS_OOO_BOUND",
+              "GS_TENANT_QUEUE_WINDOWS", "GS_AUTOTUNE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+
+
+def _stream(num_w, seed=0):
+    rng = np.random.default_rng(seed)
+    n = num_w * EB
+    return (rng.integers(0, VB, n).astype(np.int32),
+            rng.integers(0, VB, n).astype(np.int32))
+
+
+def _oracle(streams):
+    """Sync single-thread reference: one cohort, windows in order."""
+    c = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    out = {}
+    for tid in streams:
+        c.admit(tid)
+        out[tid] = []
+    for tid, (s, d) in streams.items():
+        for i in range(0, len(s), EB):
+            c.feed(tid, s[i:i + EB], d[i:i + EB])
+            out[tid] += c.pump().get(tid, [])
+    for tid in streams:
+        out[tid] += c.close(tid)
+    return out
+
+
+def _feed_all(cli, tid, src, dst, chunk=EB):
+    """Feed riding the protocol's typed backpressure retry hint —
+    the async pump compiles on its first dispatch, so early feeds can
+    legitimately fill the bounded queue."""
+    for i in range(0, len(src), chunk):
+        deadline = time.monotonic() + 60
+        while True:
+            r = cli.feed(tid, src[i:i + chunk], dst[i:i + chunk])
+            if r.get("ok"):
+                break
+            assert r["error"] == "TenantBackpressure", r
+            assert time.monotonic() < deadline, "backpressure wedged"
+            time.sleep(r.get("retry_after_s", 0.05))
+
+
+def _async_server(tmp_path, monkeypatch, **kw):
+    monkeypatch.setenv("GS_PUMP", "async")
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0, **kw).start()
+    assert srv.pump_mode == "async"
+    assert srv._pump_thread is not None and srv._pump_thread.is_alive()
+    return srv
+
+
+def test_async_pump_digest_equals_sync_oracle(tmp_path, monkeypatch):
+    streams = {"a": _stream(3, seed=1), "b": _stream(2, seed=2)}
+    want = _oracle(streams)
+    srv = _async_server(tmp_path, monkeypatch)
+    try:
+        cli = ServeClient(srv.port, timeout=60)
+        for tid, (s, d) in streams.items():
+            assert cli.admit(tid)["ok"]
+            _feed_all(cli, tid, s, d)
+        cli.close()
+        srv.drain(deadline_s=60)
+        got = {tid: [row["summary"] for row in rows]
+               for tid, rows in srv.results.items()}
+        assert got == want
+    finally:
+        srv.close()
+
+
+def test_async_pump_overlaps_ingest_with_dispatch(tmp_path,
+                                                  monkeypatch):
+    """Hang ONE dispatch on the pump thread and feed through it: the
+    accept loop keeps admitting (overlap_feeds counts them) and the
+    digest is still the oracle's — ingest never waits on dispatch."""
+    src, dst = _stream(3, seed=3)
+    want = _oracle({"t": (src, dst)})
+    srv = _async_server(tmp_path, monkeypatch)
+    try:
+        cli = ServeClient(srv.port, timeout=60)
+        cli.admit("t")
+        _feed_all(cli, "t", src[:EB], dst[:EB])
+        with faults.inject(faults.FaultSpec(
+                site="tenant_prep", on_call=1, action="hang",
+                seconds=0.6)):
+            t0 = time.monotonic()
+            # lands while the hung dispatch holds the pump thread
+            _feed_all(cli, "t", src[EB:2 * EB], dst[EB:2 * EB])
+            ingest_s = time.monotonic() - t0
+        assert ingest_s < 0.5, \
+            f"feed waited on the hung dispatch ({ingest_s:.2f}s)"
+        _feed_all(cli, "t", src[2 * EB:], dst[2 * EB:])
+        cli.close()
+        srv.drain(deadline_s=60)
+        assert srv._stats["overlap_feeds"] >= 1
+        got = [row["summary"] for row in srv.results["t"]]
+        assert got == want["t"]
+    finally:
+        srv.close()
+
+
+def test_async_pump_races_feed_close_drain(tmp_path, monkeypatch):
+    """Concurrent feeder threads against the live pump thread, closes
+    racing the last feeds, then drain: per-tenant digests equal the
+    sequential oracle — nothing lost, nothing doubled."""
+    streams = {f"t{i}": _stream(2, seed=10 + i) for i in range(3)}
+    want = _oracle(streams)
+    srv = _async_server(tmp_path, monkeypatch)
+    try:
+        errs = []
+
+        def feeder(tid, s, d):
+            try:
+                cli = ServeClient(srv.port, timeout=60)
+                cli.admit(tid)
+                _feed_all(cli, tid, s, d)
+                cli.close()
+            except Exception as e:  # surfaced after join
+                errs.append((tid, e))
+
+        threads = [threading.Thread(target=feeder, args=(tid, s, d))
+                   for tid, (s, d) in streams.items()]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(60)
+        assert not errs, errs
+        srv.drain(deadline_s=60)
+        got = {tid: [row["summary"] for row in rows]
+               for tid, rows in srv.results.items()}
+        assert got == want
+    finally:
+        srv.close()
+
+
+def test_async_pump_survives_mid_pump_fault(tmp_path, monkeypatch):
+    """A non-fatal injected fault on the pump thread's dispatch kills
+    that ROUND, not the pump: the loop reports it and the next round
+    finalizes every window — digest still the oracle's."""
+    src, dst = _stream(2, seed=4)
+    want = _oracle({"t": (src, dst)})
+    srv = _async_server(tmp_path, monkeypatch)
+    try:
+        cli = ServeClient(srv.port, timeout=60)
+        cli.admit("t")
+        with faults.inject(faults.FaultSpec(site="tenant_prep",
+                                            on_call=1)):
+            _feed_all(cli, "t", src, dst)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if len(srv.results.get("t", ())) >= 2:
+                    break
+                time.sleep(0.05)
+        cli.close()
+        srv.drain(deadline_s=60)
+        got = [row["summary"] for row in srv.results["t"]]
+        assert got == want["t"]
+    finally:
+        srv.close()
+
+
+def test_pump_default_sync_is_single_lock_legacy(tmp_path):
+    """GS_PUMP unset: no pump thread, both serve locks ARE the legacy
+    lock (bit-identical acquisition pattern), digest == oracle."""
+    src, dst = _stream(2, seed=5)
+    want = _oracle({"t": (src, dst)})
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0).start()
+    try:
+        assert srv.pump_mode == "sync"
+        assert srv._pump_thread is None
+        assert srv._ingest_lock is srv._lock
+        assert srv._pump_mutex is srv._lock
+        cli = ServeClient(srv.port, timeout=60)
+        cli.admit("t")
+        got = []
+        for i in range(0, len(src), EB):
+            assert cli.feed("t", src[i:i + EB], dst[i:i + EB])["ok"]
+            got += [row["summary"] for row in
+                    cli.pump()["results"].get("t", [])]
+        got += [row["summary"] for row in cli.close_tenant("t")["results"]]
+        cli.close()
+        assert got == want["t"]
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# subscribe
+# ----------------------------------------------------------------------
+def test_subscribe_pushes_rows_in_order(tmp_path):
+    src, dst = _stream(3, seed=6)
+    want = _oracle({"t": (src, dst)})
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0).start()
+    try:
+        sub = ServeClient(srv.port, timeout=60)
+        assert sub.subscribe("t")["ok"]
+        cli = ServeClient(srv.port, timeout=60)
+        cli.admit("t")
+        for i in range(0, len(src), EB):
+            assert cli.feed("t", src[i:i + EB], dst[i:i + EB])["ok"]
+            cli.pump()
+        cli.close_tenant("t")
+        pushed = [sub.next_window(timeout=30) for _ in range(3)]
+        assert [p["tenant"] for p in pushed] == ["t"] * 3
+        assert [p["summary"] for p in pushed] == want["t"]
+        assert [p["window"] for p in pushed] == [0, 1, 2]
+        assert srv._stats["pushed"] == 3
+        cli.close()
+        sub.close()
+    finally:
+        srv.close()
+
+
+def test_subscribe_slow_consumer_is_shed(tmp_path, monkeypatch):
+    """GS_SUB_QUEUE=1 + a sender wedged by a hung socket write: the
+    fan-out's non-blocking put overflows, the subscriber is shed with
+    a durable serve_client_shed, and the pump finishes undisturbed."""
+    monkeypatch.setenv("GS_SUB_QUEUE", "1")
+    src, dst = _stream(3, seed=7)
+    cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    srv = StreamServer(cohort, port=0).start()
+    try:
+        sub = ServeClient(srv.port, timeout=60)
+        assert sub.subscribe("*")["ok"]
+        assert srv._stats["subscribers"] == 1
+        cli = ServeClient(srv.port, timeout=60)
+        cli.admit("t")
+        for i in range(0, len(src), EB):
+            assert cli.feed("t", src[i:i + EB], dst[i:i + EB])["ok"]
+        with faults.inject(faults.FaultSpec(
+                site="serve_send", on_call=1, action="hang",
+                seconds=1.5)):
+            # one pump emits 3 rows: the hung sender holds row 1, the
+            # 1-deep mailbox holds row 2, row 3 overflows -> shed
+            r = cli.pump()
+            assert len(r["results"]["t"]) == 3
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and srv._subs:
+            time.sleep(0.05)
+        assert not srv._subs, "slow subscriber not shed"
+        assert srv._stats["shed"] >= 1
+        cli.close()
+        sub.close()
+    finally:
+        srv.close()
+
+
+# ----------------------------------------------------------------------
+# GS_OOO_BOUND reorder buffer
+# ----------------------------------------------------------------------
+def _ts_cohort():
+    c = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+    c.admit("t")
+    return c
+
+
+def test_ooo_within_bound_reorders_to_the_sorted_stream(monkeypatch):
+    """A bounded-out-of-order feed equals feeding the ts-sorted stream
+    through an unbuffered cohort: the hold releases exactly the
+    watermark-passed prefix, in stamp order."""
+    rng = np.random.default_rng(8)
+    n = 2 * EB
+    src = rng.integers(0, VB, n).astype(np.int32)
+    dst = rng.integers(0, VB, n).astype(np.int32)
+    base = np.arange(n, dtype=np.int64) * 1_000
+    jitter = rng.integers(-40, 40, n) * 1_000
+    ts = base + jitter
+    order = np.argsort(ts, kind="stable")
+    want_c = _ts_cohort()
+    want_c.feed("t", src[order], dst[order], ts=ts[order])
+    want = want_c.pump().get("t", []) + want_c.close("t")
+    monkeypatch.setenv("GS_OOO_BOUND", str(100 * 1_000))
+    c = _ts_cohort()
+    for i in range(0, n, 64):
+        c.feed("t", src[i:i + 64], dst[i:i + 64], ts=ts[i:i + 64])
+    got = c.pump().get("t", []) + c.close("t")
+    assert got == want
+
+
+def test_ooo_beyond_bound_refused_atomically(monkeypatch):
+    monkeypatch.setenv("GS_OOO_BOUND", "100")
+    c = _ts_cohort()
+    c.feed("t", [1, 2], [2, 3], ts=[1000, 2000])
+    held = c.tenants["t"].ooo_ts.copy()
+    # min ts 1500 is within the hold, but 500 reaches back past the
+    # released frontier (watermark 2000-100=1900 released ts<=1900)
+    with pytest.raises(ValueError, match="regression past"):
+        c.feed("t", [3, 4], [4, 5], ts=[1500, 500])
+    # atomic: the refused batch left the hold untouched
+    assert np.array_equal(c.tenants["t"].ooo_ts, held)
+
+
+def test_ooo_close_flushes_the_hold(monkeypatch):
+    monkeypatch.setenv("GS_OOO_BOUND", str(10**12))
+    c = _ts_cohort()
+    src, dst = _stream(1, seed=9)
+    ts = np.arange(EB, dtype=np.int64)
+    c.feed("t", src, dst, ts=ts)
+    # an astronomically wide bound holds EVERYTHING until close
+    assert c.tenants["t"].ooo_ts.size == EB
+    assert c.tenants["t"].queued == 0
+    out = c.close("t")
+    assert len(out) == 1  # the full window emerged at the boundary
+
+
+def test_ooo_watermark_lag_reaches_the_latency_plane(monkeypatch):
+    monkeypatch.setenv("GS_OOO_BOUND", str(10**12))
+    monkeypatch.setenv("GS_LATENCY", "1")
+    latency.reset()
+    try:
+        c = _ts_cohort()
+        # stamps 2s apart in ns: held lag = 2s, exactly
+        c.feed("t", [1, 2], [2, 3], ts=[0, 2_000_000_000])
+        rows = latency.health_section()["tenants"]
+        row = rows["t"]
+        assert row["watermark_held"] == 2
+        assert row["watermark_lag_s"] == pytest.approx(2.0)
+        assert latency.oldest_age() == pytest.approx(2.0)
+        c.close("t")
+    finally:
+        latency.reset()

@@ -1,0 +1,297 @@
+"""Pane-composition sliding-window suite (ISSUE 18 tentpole b):
+
+- WindowedEdgeReduce slide=: the pane path (fold each edge into its
+  pane ONCE, compose panes_per_window pane summaries per emission) is
+  bit-exact against BOTH the naive refold twin (process_stream_naive)
+  and the independent numpy oracle (sliding_numpy_reference), across
+  monoids x directions x ragged tails;
+- slide == size degenerates to tumbling, bit for bit;
+- SlidingSummaryEngine (fused scan): slide == size pin, per-emission
+  triangle recounts vs the sparse host oracle, cumulative fields ==
+  pane-granularity tumbling, kill -> resume mid-pane-ring;
+- StreamingAnalyticsDriver slide=: sliding triangle parity vs raw
+  slices, tumbling pin, checkpoint mid-ring resume + slide-mismatch
+  refusal, event-time / mesh / bad-slide refusals;
+- defaults pin: slide unset (GS_SLIDE=0) leaves every surface on the
+  legacy tumbling path.
+
+Integer values only where monoid sums are compared: float pane sums
+reassociate (pane-tree vs left fold) and are not bit-stable.
+"""
+
+import numpy as np
+import pytest
+
+from gelly_streaming_tpu.core.driver import StreamingAnalyticsDriver
+from gelly_streaming_tpu.ops.scan_analytics import (
+    SlidingSummaryEngine, StreamSummaryEngine)
+from gelly_streaming_tpu.ops.triangles import triangle_count_sparse
+from gelly_streaming_tpu.ops.windowed_reduce import (
+    WindowedEdgeReduce, sliding_numpy_reference)
+from gelly_streaming_tpu.utils import checkpoint
+
+EB, VB, SLIDE = 64, 64, 16
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for k in ("GS_SLIDE", "GS_SANITIZE", "GS_AUTOTUNE"):
+        monkeypatch.delenv(k, raising=False)
+    monkeypatch.setenv("GS_AUTOTUNE", "0")
+
+
+def _edges(n, seed=0, ids=40):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, ids, n).astype(np.int64),
+            rng.integers(0, ids, n).astype(np.int64))
+
+
+# ----------------------------------------------------------------------
+# WindowedEdgeReduce pane path
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("name,direction", [("sum", "out"),
+                                            ("min", "in"),
+                                            ("max", "all")])
+@pytest.mark.parametrize("n", [300, 256, 17, 64])
+def test_reduce_sliding_matches_naive_and_oracle(name, direction, n):
+    """The pane path == the naive refold twin == the independent
+    numpy oracle, per emission, bit for bit — full windows, growing
+    head windows, and the ragged tail alike."""
+    src, dst = _edges(n, seed=3)
+    val = np.random.default_rng(4).integers(-50, 50, n).astype(np.int64)
+    eng = WindowedEdgeReduce(VB, EB, name=name, direction=direction,
+                             slide=SLIDE)
+    assert eng.panes_per_window == EB // SLIDE
+    got = eng.process_stream(src, dst, val)
+    twin = WindowedEdgeReduce(VB, EB, name=name, direction=direction,
+                              slide=SLIDE)
+    naive = twin.process_stream_naive(src, dst, val)
+    oracle = sliding_numpy_reference(src, dst, val, EB, SLIDE,
+                                     direction=direction, name=name)
+    assert len(got) == len(naive) == len(oracle) == -(-n // SLIDE)
+    for i, ((gc, gn), (nc, nn), (oc, on)) in enumerate(
+            zip(got, naive, oracle)):
+        assert np.array_equal(gn, nn), f"counts diverge at emission {i}"
+        assert np.array_equal(gn[:len(on)], on)
+        # touched cells value-identical; count-0 cells compare by count
+        mask = gn > 0
+        assert np.array_equal(gc[mask], nc[mask]), \
+            f"cells diverge at emission {i}"
+        assert np.array_equal(gc[:len(oc)][mask[:len(oc)]],
+                              oc[mask[:len(oc)]])
+
+
+def test_reduce_slide_equals_size_is_tumbling():
+    """slide == size runs the pane machinery with exactly one pane
+    per window — bit-identical to the plain tumbling engine."""
+    src, dst = _edges(200, seed=5)
+    val = np.arange(200, dtype=np.int64)
+    a = WindowedEdgeReduce(VB, EB, name="sum").process_stream(
+        src, dst, val)
+    b = WindowedEdgeReduce(VB, EB, name="sum",
+                           slide=EB).process_stream(src, dst, val)
+    assert len(a) == len(b)
+    for (ac, an), (bc, bn) in zip(a, b):
+        assert np.array_equal(an, bn) and np.array_equal(ac, bc)
+
+
+def test_reduce_slide_validation():
+    with pytest.raises(ValueError, match="power of two dividing"):
+        WindowedEdgeReduce(VB, EB, name="sum", slide=24)
+    with pytest.raises(ValueError, match="power of two dividing"):
+        WindowedEdgeReduce(VB, EB, name="sum", slide=2 * EB)
+    with pytest.raises(ValueError, match="monoid"):
+        WindowedEdgeReduce(VB, EB, fn=lambda a, b: a + b, slide=SLIDE)
+
+
+# ----------------------------------------------------------------------
+# SlidingSummaryEngine (fused scan)
+# ----------------------------------------------------------------------
+def test_scan_slide_equals_size_pin():
+    """One pane per window: the sliding wrapper's rows equal the plain
+    engine's tumbling digests exactly (the wrapper adds nothing but
+    the triangle recount, which sees the identical slab)."""
+    src, dst = _edges(7 * EB, seed=6, ids=VB)
+    plain = StreamSummaryEngine(edge_bucket=EB, vertex_bucket=VB)
+    slid = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                                slide=EB)
+    assert slid.process(src, dst) == plain.process(src, dst)
+
+
+def test_scan_sliding_triangles_vs_sparse_oracle():
+    """Every emission's triangle count == the sparse host count of the
+    raw trailing-window slice (growing head + ragged tail included)."""
+    n = 25 * SLIDE + 7
+    src, dst = _edges(n, seed=7, ids=VB)
+    eng = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                               slide=SLIDE)
+    rows = eng.process(src, dst)
+    assert len(rows) == -(-n // SLIDE)
+    for i, row in enumerate(rows):
+        lo = max(0, (i + 1) * SLIDE - EB)
+        hi = min((i + 1) * SLIDE, n)
+        want = int(triangle_count_sparse(
+            np.asarray(src[lo:hi], np.int32),
+            np.asarray(dst[lo:hi], np.int32), VB))
+        assert row["triangles"] == want, f"emission {i}"
+
+
+def test_scan_sliding_cumulative_fields_are_pane_tumbling():
+    """max_degree / num_components / odd_cycle are cumulative: the
+    sliding rows carry exactly the pane-granularity tumbling values."""
+    src, dst = _edges(6 * SLIDE, seed=8, ids=VB)
+    slid = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                                slide=SLIDE).process(src, dst)
+    pane = StreamSummaryEngine(edge_bucket=SLIDE,
+                               vertex_bucket=VB).process(src, dst)
+    assert len(slid) == len(pane)
+    for s_row, p_row in zip(slid, pane):
+        for k, v in p_row.items():
+            if k != "triangles":
+                assert s_row[k] == v
+
+
+def test_scan_sliding_kill_resume_mid_pane_ring(tmp_path):
+    """Kill after a pane count that leaves the ring mid-fill, resume
+    from the checkpoint: the tail emissions recompose the SAME windows
+    the uninterrupted run emits."""
+    n = 13 * SLIDE
+    src, dst = _edges(n, seed=9, ids=VB)
+    ref = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                               slide=SLIDE).process(src, dst)
+    cut = 7 * SLIDE  # ring holds wp-1 = 3 panes: mid-stream, full ring
+    a = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                             slide=SLIDE)
+    head = a.process(src[:cut], dst[:cut])
+    path = str(tmp_path / "ck")
+    checkpoint.save(path, a.state_dict())
+    b = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                             slide=SLIDE)
+    state, _used = checkpoint.load_latest(path)
+    b.load_state_dict(state)
+    assert b.resume_offset() == cut
+    tail = b.process(src[cut:], dst[cut:])
+    assert head + tail == ref
+    # mismatched geometry refuses loudly
+    c = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                             slide=SLIDE // 2)
+    with pytest.raises(ValueError, match="slide"):
+        c.load_state_dict(state)
+
+
+def test_scan_slide_validation():
+    for bad in (24, 0, 2 * EB):
+        with pytest.raises(ValueError, match="power of two dividing"):
+            SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                                 slide=bad)
+
+
+# ----------------------------------------------------------------------
+# driver slide=
+# ----------------------------------------------------------------------
+def _driver(slide=None, analytics=StreamingAnalyticsDriver.ANALYTICS):
+    return StreamingAnalyticsDriver(
+        window_ms=1000, analytics=analytics, vertex_bucket=VB,
+        edge_bucket=EB, slide=slide)
+
+
+def test_driver_sliding_triangles_vs_sparse_oracle():
+    n = 300
+    src, dst = _edges(n, seed=10)
+    out = _driver(slide=SLIDE).run_arrays(src, dst)
+    assert len(out) == -(-n // SLIDE)
+    for i, res in enumerate(out):
+        lo = max(0, (i + 1) * SLIDE - EB)
+        hi = min((i + 1) * SLIDE, n)
+        s_sl, d_sl = src[lo:hi], dst[lo:hi]
+        ids = np.unique(np.concatenate([s_sl, d_sl]))
+        remap = {v: k for k, v in enumerate(ids)}
+        want = int(triangle_count_sparse(
+            np.array([remap[v] for v in s_sl], np.int32),
+            np.array([remap[v] for v in d_sl], np.int32), len(ids)))
+        assert res.triangles == want, f"emission {i}"
+        assert res.num_edges == hi - i * SLIDE
+
+
+def test_driver_sliding_cumulative_equals_pane_tumbling():
+    """degrees/cc/bipartite are running snapshots: pane-sized sliding
+    emissions equal a tumbling driver cut at the pane size."""
+    src, dst = _edges(240, seed=11)
+    names = ("degrees", "cc", "bipartite")
+    slid = _driver(slide=SLIDE, analytics=names).run_arrays(src, dst)
+    pane = StreamingAnalyticsDriver(
+        window_ms=1000, analytics=names, vertex_bucket=VB,
+        edge_bucket=SLIDE).run_arrays(src, dst)
+    assert len(slid) == len(pane)
+    for a, b in zip(slid, pane):
+        assert np.array_equal(a.degrees, b.degrees)
+        assert np.array_equal(a.cc_labels, b.cc_labels)
+        assert np.array_equal(a.bipartite_odd, b.bipartite_odd)
+
+
+def test_driver_slide_equals_eb_is_tumbling():
+    src, dst = _edges(200, seed=12)
+    a = _driver().run_arrays(src, dst)
+    b = _driver(slide=EB).run_arrays(src, dst)
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        assert x.triangles == y.triangles
+        assert np.array_equal(x.degrees, y.degrees)
+        assert np.array_equal(x.cc_labels, y.cc_labels)
+
+
+def test_driver_sliding_kill_resume_mid_pane_ring(tmp_path):
+    n = 240
+    src, dst = _edges(n, seed=13)
+    ref = _driver(slide=SLIDE).run_arrays(src, dst)
+    cut = 7 * SLIDE
+    a = _driver(slide=SLIDE)
+    head = a.run_arrays(src[:cut], dst[:cut])
+    path = str(tmp_path / "ck")
+    checkpoint.save(path, a.state_dict())
+    b = _driver(slide=SLIDE)
+    state, _used = checkpoint.load_latest(path)
+    b.load_state_dict(state)
+    tail = b.run_arrays(src[cut:], dst[cut:])
+    both = head + tail
+    assert len(both) == len(ref)
+    for x, y in zip(both, ref):
+        assert x.triangles == y.triangles
+        assert np.array_equal(x.degrees, y.degrees)
+        assert np.array_equal(x.cc_labels, y.cc_labels)
+    # slide mismatch (either direction) refuses loudly
+    for other in (None, SLIDE * 2):
+        c = _driver(slide=other)
+        with pytest.raises(ValueError, match="slide mismatch"):
+            c.load_state_dict(state)
+
+
+def test_driver_slide_refusals():
+    with pytest.raises(ValueError, match="power of two dividing"):
+        _driver(slide=24)
+    with pytest.raises(ValueError, match="single-chip"):
+        StreamingAnalyticsDriver(window_ms=1000, vertex_bucket=VB,
+                                 edge_bucket=EB, slide=SLIDE,
+                                 mesh=object())
+    d = _driver(slide=SLIDE)
+    with pytest.raises(ValueError, match="count-based"):
+        d.run_arrays(*_edges(10, seed=14), ts=np.arange(10))
+
+
+def test_driver_gs_slide_knob_arms_and_default_stays_legacy(
+        monkeypatch):
+    """GS_SLIDE arms the driver exactly like the ctor param; the unset
+    default leaves the legacy tumbling cut untouched."""
+    src, dst = _edges(128, seed=15)
+    default = _driver()
+    assert default.slide is None and default._wp == 1
+    base = default.run_arrays(src, dst)
+    assert len(base) == 2  # eb-sized tumbling windows
+    monkeypatch.setenv("GS_SLIDE", str(SLIDE))
+    armed = _driver()
+    assert armed.slide == SLIDE
+    out = armed.run_arrays(src, dst)
+    assert len(out) == 128 // SLIDE
+    want = _driver(slide=SLIDE).run_arrays(src, dst)
+    for x, y in zip(out, want):
+        assert x.triangles == y.triangles

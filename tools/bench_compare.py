@@ -59,7 +59,8 @@ RATE_FIELDS = (
     "tenant_edges_per_s", "sequential_edges_per_s",
 )
 RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline",
-                "cohort_speedup")
+                "cohort_speedup", "queue_wait_improvement",
+                "e2e_improvement")
 
 # latency identities (LOWER is better — the comparison inverts):
 # any field both rows share whose name ends in a percentile-seconds
@@ -86,6 +87,7 @@ PERF_SECTIONS = {
     "egress_ab": ("probe",),
     "resident_ab": ("probe",),
     "tenancy_ab": ("probe", "tenants"),
+    "pump_ab": ("probe",),
     "autotune": ("engine", "edge_bucket"),
 }
 

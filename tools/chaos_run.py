@@ -1214,6 +1214,175 @@ def _poison_drain_subleg(workdir, np, streams, oracle, eb, vb,
             "dlq_edges": info["edges"]}
 
 
+def leg_pump(workdir: str) -> dict:
+    """The async-pump drill (GS_PUMP=async, core/serve.py): two
+    tenants fed through a loopback server whose DEDICATED pump thread
+    owns dispatch.
+
+      · OVERLAP: one dispatch is hung mid-run and an ingest batch is
+        accepted while it is in flight (overlap_feeds > 0) — the leg
+        proves the overlap path, never a quietly serialized pump.
+      · KILL mid-pump: a fatal InjectedFault fires INSIDE the pump
+        thread (the ingest side keeps acking — the WAL is the only
+        survivor). A fresh async server recovers (checkpoint resume +
+        WAL suffix replay), the un-acked suffix is re-fed, and the
+        union of pre-kill deliveries + post-recovery deliveries is
+        bit-identical to the fault-free sync direct-feed oracle —
+        at-least-once under a pump-thread death, deduped by window
+        ordinal.
+    """
+    import time
+
+    from gelly_streaming_tpu.core.serve import (ServeClient,
+                                                StreamServer)
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+
+    eb, vb, num_w = 512, 1024, 6
+    streams = {}
+    for i in range(2):
+        s, d = make_stream(num_w * eb, vb, seed=90 + i)
+        streams["p%d" % i] = (s.astype(np.int32), d.astype(np.int32))
+
+    # fault-free oracle: the direct sync cohort feed
+    oracle = {}
+    co = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+    for tid in streams:
+        co.admit(tid)
+    for w in range(num_w):
+        for tid, (s, d) in sorted(streams.items()):
+            co.feed(tid, s[w * eb:(w + 1) * eb],
+                    d[w * eb:(w + 1) * eb])
+        for tid, res in co.pump().items():
+            oracle.setdefault(tid, []).extend(res)
+    for tid in streams:
+        oracle[tid].extend(co.close(tid))
+
+    wal_dir = os.path.join(workdir, "pump_wal")
+    ck_dir = os.path.join(workdir, "pump_ckpt")
+    got = {tid: {} for tid in streams}
+    cursors = {tid: 0 for tid in streams}
+
+    def take(srv):
+        for tid, rows in srv.results.items():
+            for row in rows:
+                got[tid][row["window"]] = row["summary"]
+
+    def feed_one(cli, tid):
+        s, d = streams[tid]
+        c = cursors[tid]
+        deadline = time.monotonic() + 60
+        while True:
+            r = cli.feed(tid, s[c:c + eb], d[c:c + eb])
+            if r.get("ok"):
+                cursors[tid] = c + eb
+                return
+            if r.get("error") != "TenantBackpressure" \
+                    or time.monotonic() > deadline:
+                raise SystemExit("chaos pump leg: feed refused: %r"
+                                 % (r,))
+            time.sleep(r.get("retry_after_s", 0.05))
+
+    prev = os.environ.get("GS_PUMP")
+    os.environ["GS_PUMP"] = "async"
+    try:
+        cohort = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        assert cohort.enable_wal(wal_dir)
+        cohort.enable_auto_checkpoint(ck_dir, every_n_windows=2)
+        server = StreamServer(cohort, port=0).start()
+        if server._pump_thread is None or \
+                not server._pump_thread.is_alive():
+            raise SystemExit("chaos pump leg: GS_PUMP=async started "
+                             "no pump thread")
+        cli = ServeClient(server.port, timeout=60)
+        for tid in sorted(streams):
+            assert cli.admit(tid)["ok"]
+        # window 0: plain async feeds, the pump delivers on its own
+        for tid in sorted(streams):
+            feed_one(cli, tid)
+        # window 1: the overlap proof — hang one dispatch and land a
+        # feed inside it (the ingest lock never waits on the pump)
+        tids = sorted(streams)
+        with faults.inject(faults.FaultSpec(
+                site="tenant_prep", on_call=1, action="hang",
+                seconds=0.5)):
+            feed_one(cli, tids[0])
+            time.sleep(0.1)  # let the pump pick the hang up
+        for tid in tids[1:]:
+            feed_one(cli, tid)
+        overlap = int(server._stats.get("overlap_feeds", 0))
+        # window 2: the kill — fatal fault INSIDE the pump thread
+        with faults.inject(faults.FaultSpec(
+                site="tenant_prep", on_call=1, fatal=True)) as plan:
+            for tid in tids:
+                feed_one(cli, tid)
+            deadline = time.monotonic() + 30
+            while not server.fatal \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            fired = list(plan.fired)
+        if not server.fatal:
+            raise SystemExit("chaos pump leg: the mid-pump kill "
+                             "never fired (fired=%r)" % (fired,))
+        take(server)
+        try:
+            cli.close()
+        except OSError:
+            pass
+        server.close()  # the simulated process death
+
+        # restart: fresh cohort + async server, checkpoint resume +
+        # WAL suffix replay; re-feed only the un-acked suffix
+        co2 = TenantCohort(edge_bucket=eb, vertex_bucket=vb)
+        assert co2.enable_wal(wal_dir)
+        co2.enable_auto_checkpoint(ck_dir, every_n_windows=2)
+        rec = co2.recover()
+        if not _ledger_has("wal_replayed"):
+            raise SystemExit("chaos pump leg: no durable "
+                             "wal_replayed event in the ledger")
+        s2 = StreamServer(co2, port=0).start()
+        cli2 = ServeClient(s2.port, timeout=60)
+        live = True
+        while live:
+            live = False
+            for tid in tids:
+                if cursors[tid] >= num_w * eb:
+                    continue
+                feed_one(cli2, tid)
+                live = True
+        for tid in tids:
+            assert cli2.close_tenant(tid)["ok"]
+        cli2.close()
+        s2.drain(deadline_s=60)
+        take(s2)
+        s2.close()
+    finally:
+        if prev is None:
+            os.environ.pop("GS_PUMP", None)
+        else:
+            os.environ["GS_PUMP"] = prev
+
+    final = {tid: [got[tid][k] for k in sorted(got[tid])]
+             for tid in streams}
+    for tid in streams:
+        if final[tid] != oracle[tid]:
+            raise SystemExit(
+                "chaos pump leg DIVERGED from the fault-free oracle "
+                "for tenant %s (%d vs %d windows)"
+                % (tid, len(final[tid]), len(oracle[tid])))
+    if overlap < 1:
+        raise SystemExit("chaos pump leg: the async pump never "
+                         "overlapped ingest with dispatch "
+                         "(overlap_feeds=0)")
+    return {
+        "parity": True,
+        "overlap_feeds": overlap,
+        "replayed_edges": rec["replayed_edges"],
+        "faults_fired": [list(f) for f in fired],
+        "digests": {tid: _summaries_digest(final[tid])
+                    for tid in sorted(streams)},
+    }
+
+
 def leg_mesh(eb: int, vb: int, num_w: int, n_shards: int,
              workdir: str) -> dict:
     """The mesh drill: a sharded driver on the virtual CPU mesh takes
@@ -1638,15 +1807,20 @@ def main():
             # tenants stay bit-identical, and a serve subprocess
             # drains rc=0 under the same flood
             po = leg_poison(workdir)
+            # pump leg: GS_PUMP=async — real ingest/dispatch overlap,
+            # then a fatal kill INSIDE the pump thread → WAL-replay
+            # recovery into a fresh async server, per-tenant digests
+            # equal the sync fault-free oracle
+            pp = leg_pump(workdir)
             # mesh leg: corrupt wire → retry, dead shard → demotion →
             # parity, n-shard checkpoint → 1-device + host-twin resume
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
                           args.mesh_devices, workdir)
                  if args.mesh_devices else None)
-            # flight-recorder leg: six kills fired above (driver,
-            # autotune, resident, engine, tenancy, serve) — the
+            # flight-recorder leg: seven kills fired above (driver,
+            # autotune, resident, engine, tenancy, serve, pump) — the
             # ledger must prove all
-            fr = assert_flight_recorder(num_kills=6)
+            fr = assert_flight_recorder(num_kills=7)
             fr["span_summary"] = telemetry.summary(top=12)
         finally:
             telemetry.reset()  # close the ledger inside the tempdir
@@ -1692,10 +1866,15 @@ def main():
             classes.add("poison_isolation")
     if po["dlq_recovered"]:
         classes.add("dlq_recovery")
+    for site, _n, action in pp["faults_fired"]:
+        if site == "tenant_prep" and action == "raise":
+            classes.add("pump_kill_replay")
+    if pp["overlap_feeds"] >= 1:
+        classes.add("pump_overlap")
     required |= {"serve_kill_replay", "serve_torn_tail",
                  "serve_slow_client_shed", "serve_sigterm_drain",
                  "latency_replay_stamps", "poison_isolation",
-                 "dlq_recovery"}
+                 "dlq_recovery", "pump_kill_replay", "pump_overlap"}
     if m is not None:
         for site, _n, action in m["faults_fired"]:
             if action == "corrupt_shard":
@@ -1718,7 +1897,10 @@ def main():
     summary = {
         "edges": args.edges, "edge_bucket": args.eb,
         "vertices": args.vertices,
-        "knobs": KNOBS,
+        # effective values: KNOBS applies via setdefault, so an env
+        # override (e.g. a slower machine widening the stage deadline)
+        # must show up in the committed artifact
+        "knobs": {k: os.environ.get(k, v) for k, v in KNOBS.items()},
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
         "resident_leg": rs,
         "health_leg": h,
@@ -1726,6 +1908,7 @@ def main():
         "serve_leg": sv,
         "latency_leg": ly,
         "poison_leg": po,
+        "pump_leg": pp,
         "mesh_leg": m,
         "flight_recorder_leg": fr,
         "gslint_leg": gl,

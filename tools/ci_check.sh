@@ -43,6 +43,12 @@
 #      super-batch program (resident_dispatches > 0) — a silent
 #      decline to the scan tier fails the gate instead of passing
 #      vacuously
+#  11. async-pump smoke (tools/pump_smoke.py): a GS_PUMP=async
+#      loopback run must be digest-identical per tenant to the sync
+#      single-lock legacy AND must actually overlap ingest with
+#      dispatch (overlap_feeds > 0, forced deterministically by a
+#      hung dispatch) — a pump that quietly serializes fails; plus
+#      the sliding default pin (slide == edge_bucket ≡ tumbling)
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -51,39 +57,42 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/10] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/11] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/10] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/11] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/10] gslint =="
+echo "== [2/11] gslint =="
 python -m tools.gslint
 
-echo "== [3/10] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/11] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/10] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/11] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/10] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/11] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
-echo "== [6/10] serve parity smoke (loopback + drain ≡ direct feed) =="
+echo "== [6/11] serve parity smoke (loopback + drain ≡ direct feed) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
-echo "== [7/10] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
+echo "== [7/11] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
 JAX_PLATFORMS=cpu python tools/pallas_smoke.py
 
-echo "== [8/10] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
+echo "== [8/11] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
 JAX_PLATFORMS=cpu python tools/latency_smoke.py
 
-echo "== [9/10] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
+echo "== [9/11] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
 JAX_PLATFORMS=cpu python tools/poison_smoke.py
 
-echo "== [10/10] cohort-resident smoke (resident tier ≡ single streams, no silent decline) =="
+echo "== [10/11] cohort-resident smoke (resident tier ≡ single streams, no silent decline) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --resident-smoke
+
+echo "== [11/11] async-pump smoke (async ≡ sync, real overlap; sliding pin) =="
+JAX_PLATFORMS=cpu python tools/pump_smoke.py
 
 echo "ci_check: all gates green"

@@ -552,6 +552,13 @@ class ThreadSharedRule(Rule):
         # the admission sanitizer + dead-letter journal: serve
         # connection threads and the pump both reject (ISSUE 15)
         PKG + "/utils/sanitize.py",
+        # the async pump: the dedicated pump thread runs cohort
+        # dispatch (and the resident mailbox) concurrently with the
+        # ingest-side connection/tail threads (ISSUE 18)
+        PKG + "/core/tenancy.py",
+        PKG + "/ops/resident_engine.py",
+        PKG + "/utils/latency.py",
+        PKG + "/ops/scan_analytics.py",
     )
 
     def check_module(self, ctx: ModuleCtx) -> List[Finding]:

@@ -49,6 +49,12 @@ LIST_SECTIONS = {
     # cohort_pallas (tenant-axis Pallas megakernel; off-chip rows must
     # be interpret-marked, see _check_rows)
     "tenancy_ab": ("probe", "parity", "tenants"),
+    # async-pump / sliding-pane A/B (tools/pump_ab.py). Probes:
+    # serving_pump (GS_PUMP=async vs sync on a paced 8-tenant loopback
+    # serve run, per-tenant sha256 parity, queue_wait/e2e p99
+    # improvements), sliding_panes (pane-composed sliding reduce vs
+    # the naive refold twin, bit-exact parity)
+    "pump_ab": ("probe", "parity"),
     "autotune": ("engine", "parity"),
     "pipeline_stages": ("engine", "edge_bucket"),
     "chunk_deep": ("edge_bucket",),
@@ -113,7 +119,7 @@ _COST_PROGRAM_KEYS = ("program", "sig", "flops", "bytes_accessed",
 # A/B sections whose parity-true rows must claim a positive speedup
 # (the adoption gates divide by it; rows_clear_bar rejects otherwise)
 _AB_SECTIONS = ("ingress_ab", "egress_ab", "resident_ab",
-                "tenancy_ab", "pallas_ab")
+                "tenancy_ab", "pallas_ab", "pump_ab")
 
 
 def _check_rows(name: str, rows, errors) -> None:
@@ -269,6 +275,14 @@ _CHAOS_LEGS = {
     # while the healthy tenants stay bit-identical; the serve
     # subprocess under the flood must still drain rc=0
     "poison_leg": ("parity", "quarantined", "dlq_recovered", "drain"),
+    # the async-pump drill (ISSUE 18): SIGKILL a GS_PUMP=async serve
+    # subprocess mid-pump, WAL-replay into a fresh async server, and
+    # the union of pre-kill deliveries + replayed windows must be
+    # digest-identical to the sync fault-free oracle — with at least
+    # one ingest batch accepted while a dispatch was in flight
+    # (overlap_feeds > 0: the leg proves the overlap path, not a
+    # quietly serialized pump)
+    "pump_leg": ("parity", "faults_fired", "overlap_feeds"),
 }
 
 

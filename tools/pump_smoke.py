@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""CI gate for the async serving pump (tools/ci_check.sh [11/11]):
+
+an armed loopback run under GS_PUMP=async must
+
+  1. produce per-tenant summary digests BYTE-IDENTICAL to the
+     GS_PUMP=sync legacy path on the same streams (the pump can never
+     silently drift the serving semantics), and
+  2. actually OVERLAP ingest with dispatch: at least one feed must be
+     accepted while a dispatch is in flight (`overlap_feeds` > 0,
+     counted at the ingest lock while the pump thread's busy flag is
+     set). A vacuous pass — async mode that quietly serializes — fails
+     the gate. Overlap is forced deterministically by hanging one
+     dispatch (a `tenant_prep` hang fault) and feeding through it.
+
+Also pins the sliding defaults: a GS_SLIDE-armed SlidingSummaryEngine
+at slide == edge_bucket must equal the tumbling engine digest (one
+pane per window = the legacy path), in seconds not minutes.
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from bench import make_stream  # noqa: E402
+from tools.tenancy_ab import digest_summaries, scoped_env  # noqa: E402
+
+EB, VB = 512, 1024
+
+
+def _feed_retry(cli, tid, s, d):
+    deadline = time.monotonic() + 60
+    while True:
+        r = cli.feed(tid, s, d)
+        if r.get("ok"):
+            return
+        if r.get("error") != "TenantBackpressure" \
+                or time.monotonic() > deadline:
+            raise RuntimeError("feed refused: %s" % r)
+        time.sleep(r.get("retry_after_s", 0.05))
+
+
+def _serve_digests(streams, mode: str, hang_one: bool = False):
+    """Feed `streams` through a loopback server under GS_PUMP=`mode`;
+    returns (per-tenant digests, overlap_feeds). With hang_one, one
+    dispatch is hung mid-run and a window is fed through it — the
+    deterministic overlap proof."""
+    from gelly_streaming_tpu.core.serve import ServeClient, StreamServer
+    from gelly_streaming_tpu.core.tenancy import TenantCohort
+    from gelly_streaming_tpu.utils import faults
+
+    with scoped_env(GS_PUMP=mode):
+        cohort = TenantCohort(edge_bucket=EB, vertex_bucket=VB)
+        srv = StreamServer(cohort, port=0).start()
+        try:
+            cli = ServeClient(srv.port, timeout=60)
+            for tid in streams:
+                cli.admit(tid)
+            cursors = {tid: 0 for tid in streams}
+            fed_rounds = 0
+            live = True
+            while live:
+                live = False
+                for tid, (s, d) in streams.items():
+                    c = cursors[tid]
+                    if c >= len(s):
+                        continue
+                    hi = min(c + EB, len(s))
+                    if hang_one and fed_rounds == 1 and c == EB:
+                        # round 2, first tenant: hang the NEXT
+                        # dispatch and land this feed inside it
+                        with faults.inject(faults.FaultSpec(
+                                site="tenant_prep", on_call=1,
+                                action="hang", seconds=0.5)):
+                            _feed_retry(cli, tid, s[c:hi], d[c:hi])
+                            time.sleep(0.1)  # let the pump pick it up
+                    else:
+                        _feed_retry(cli, tid, s[c:hi], d[c:hi])
+                    cursors[tid] = hi
+                    live = True
+                fed_rounds += 1
+                if mode == "sync":
+                    cli.pump()
+            cli.close()
+            srv.drain(deadline_s=60)
+            digests = {tid: digest_summaries(
+                [row["summary"] for row in rows])
+                for tid, rows in srv.results.items()}
+            return digests, srv._stats.get("overlap_feeds", 0)
+        finally:
+            srv.close()
+
+
+def pump_gate() -> int:
+    streams = {}
+    for i in range(2):
+        n = 3 * EB - (EB // 4 if i else 0)  # one ragged tenant
+        s, d = make_stream(n, VB, seed=31 + i)
+        streams["t%d" % i] = (s.astype(np.int32), d.astype(np.int32))
+    want, _ = _serve_digests(streams, "sync")
+    got, overlap = _serve_digests(streams, "async", hang_one=True)
+    bad = [t for t in streams if got.get(t) != want[t]]
+    if bad:
+        print("pump smoke FAILED: tenants %s diverged from the sync "
+              "legacy path (async %s vs sync %s)"
+              % (bad, got, want), file=sys.stderr)
+        return 1
+    if overlap < 1:
+        print("pump smoke FAILED: GS_PUMP=async never overlapped "
+              "ingest with dispatch (overlap_feeds=0) — the pump "
+              "thread is serializing", file=sys.stderr)
+        return 1
+    print("pump smoke ok: async ≡ sync per tenant (%s), "
+          "%d overlapped feed(s)"
+          % (", ".join(sorted(want.values())), overlap), flush=True)
+    return 0
+
+
+def sliding_gate() -> int:
+    from gelly_streaming_tpu.ops.scan_analytics import (
+        SlidingSummaryEngine, StreamSummaryEngine)
+
+    n = 3 * EB + EB // 4
+    s, d = make_stream(n, VB, seed=37)
+    s, d = s.astype(np.int32), d.astype(np.int32)
+    want = StreamSummaryEngine(edge_bucket=EB,
+                               vertex_bucket=VB).process(s, d)
+    got = SlidingSummaryEngine(edge_bucket=EB, vertex_bucket=VB,
+                               slide=EB).process(s, d)
+    if digest_summaries(got) != digest_summaries(want):
+        print("pump smoke FAILED: slide == edge_bucket is not the "
+              "tumbling digest (%s vs %s)"
+              % (digest_summaries(got), digest_summaries(want)),
+              file=sys.stderr)
+        return 1
+    print("sliding smoke ok: slide==size ≡ tumbling (%s, %d windows)"
+          % (digest_summaries(got), len(got)), flush=True)
+    return 0
+
+
+def main() -> int:
+    os.environ["GS_AUTOTUNE"] = "0"
+    rc = pump_gate()
+    if rc:
+        return rc
+    return sliding_gate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
