@@ -734,8 +734,85 @@ def run_cohort_leg(metric_suffix: str = "") -> None:
     }), flush=True)
 
 
+def run_gnn_leg(metric_suffix: str = "") -> None:
+    """Windowed-GNN message-passing scenario (ops/gnn_window): the
+    fused per-window GNN round (segment-sum aggregation + the dense
+    MXU update) over a power-law stream. Parity vs the numpy lattice
+    twin is asserted — summary stream AND final feature slab — before
+    any rate is reported; the metric unit is edge-features/s (edges ×
+    feature_dim per second), the axis the dense update actually
+    scales on. tools/gnn_ab.py owns the deeper committed evidence;
+    this leg keeps the regression sentry's eye on the workload every
+    bench run."""
+    from gelly_streaming_tpu.ops import gnn_window as gw
+    from gelly_streaming_tpu.utils import knobs as _knobs
+    from gelly_streaming_tpu.utils import telemetry as _telemetry
+    from tools.gnn_ab import (digest_slab, digest_summaries,
+                              run_engine)
+
+    eb, vb, F, windows = 512, 1024, 16, 16
+    n = windows * eb - eb // 3  # ragged tail: the partial-window path
+    src, dst = make_stream(n, vb, seed=7)
+    src, dst = src.astype(np.int32), dst.astype(np.int32)
+
+    got, slab = run_engine(gw.GnnSummaryEngine, eb, vb, F, src, dst)
+    want, wslab = run_engine(gw.GnnHostEngine, eb, vb, F, src, dst)
+    assert digest_summaries(got) == digest_summaries(want) \
+        and digest_slab(slab) == digest_slab(wslab), \
+        "GNN round diverged from the numpy lattice twin"
+
+    reps = int(os.environ.get("GS_BENCH_REPS", "3"))
+    dev_ts, host_ts = [], []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run_engine(gw.GnnSummaryEngine, eb, vb, F, src, dst)
+        dev_ts.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_engine(gw.GnnHostEngine, eb, vb, F, src, dst)
+        host_ts.append(time.perf_counter() - t0)
+    dev_s = float(np.median(dev_ts))
+    host_s = float(np.median(host_ts))
+
+    print(json.dumps({
+        "metric": "edge-features/sec/chip, windowed GNN round "
+                  "(%d-edge windows, F=%d, fused scan vs numpy "
+                  "twin)%s" % (eb, F, metric_suffix),
+        "value": round(n * F / dev_s),
+        "unit": "edge-features/s",
+        "num_edges": n,
+        "feature_dim": F,
+        "gnn_edge_features_per_s": round(n * F / dev_s),
+        "edges_per_s": round(n / dev_s),
+        "host_edges_per_s": round(n / host_s),
+        "parity": True,
+        "knobs": {"eb": eb, "vb": vb, "feature_dim": F,
+                  "act": _knobs.get_str("GS_GNN_ACT") or "relu",
+                  "pallas": _knobs.get_str("GS_GNN_PALLAS")
+                  or "auto"},
+        "trace": _telemetry.trace_id(),
+    }), flush=True)
+
+
 def main():
     metric_suffix = ""
+    if os.environ.get("GS_BENCH_GNN"):
+        # GNN-leg child (same re-exec/watchdog/capacity contract as
+        # the scale children)
+        if "--cpu" in sys.argv or os.environ.get(
+                "GS_BENCH_CPU_FALLBACK") == "1":
+            from gelly_streaming_tpu.core.platform import use_cpu
+            use_cpu()
+        try:
+            run_gnn_leg(os.environ.get("GS_BENCH_SUFFIX", ""))
+        except AssertionError:
+            raise  # parity failure: NEVER mask a correctness regression
+        except Exception as e:
+            if _is_resource_error(e) or _is_backend_drop(e):
+                print("gnn leg: %s: %s" % (type(e).__name__, e),
+                      file=sys.stderr)
+                sys.exit(EXIT_CAPACITY)
+            raise
+        return
     if os.environ.get("GS_BENCH_COHORT"):
         # cohort-leg child (same re-exec/watchdog/capacity contract
         # as the scale children)
@@ -862,6 +939,17 @@ def main():
         sys.exit(rc)
     if rc:
         print("cohort leg rc=%d (capacity/timeout); other lines kept"
+              % rc, file=sys.stderr)
+
+    # windowed-GNN leg (ops/gnn_window) — watchdogged like the
+    # others; capacity/timeout keeps the completed lines, a parity
+    # failure still fails the bench
+    rc = run_scale_watchdogged(0.0, metric_suffix,
+                               extra_env={"GS_BENCH_GNN": "1"})
+    if rc not in (0, EXIT_CAPACITY, EXIT_TIMEOUT):
+        sys.exit(rc)
+    if rc:
+        print("gnn leg rc=%d (capacity/timeout); other lines kept"
               % rc, file=sys.stderr)
 
 

@@ -24,6 +24,9 @@ from .core.graphstream import GraphStream, GraphWindowStream, SimpleEdgeStream
 from .core.gtime import (AscendingTimestampExtractor, ManualClock, SystemClock,
                          Time, TimeCharacteristic)
 from .core.types import NULL, Edge, EdgeDirection, NullValue, Vertex
+from .core.tenancy import GnnTenantCohort
+from .ops.gnn_window import (GnnHostEngine, GnnResidentEngine,
+                             GnnSummaryEngine)
 
 __version__ = "0.1.0"
 
@@ -34,4 +37,6 @@ __all__ = [
     "AscendingTimestampExtractor", "ManualClock", "SystemClock", "Time",
     "TimeCharacteristic", "NULL", "Edge", "EdgeDirection", "NullValue",
     "Vertex", "StreamingAnalyticsDriver", "WindowResult",
+    "GnnSummaryEngine", "GnnResidentEngine", "GnnHostEngine",
+    "GnnTenantCohort",
 ]

@@ -1886,3 +1886,330 @@ class TenantCohort:
 
     def windows_done(self, tenant_id) -> int:
         return self._tenant(tenant_id).windows_done
+
+
+# ----------------------------------------------------------------------
+# the GNN cohort (ops/gnn_window on the tenant axis)
+# ----------------------------------------------------------------------
+class GnnTenantCohort:
+    """N tenants' windowed GNN rounds on ONE vmapped dispatch — the
+    multi-tenant serving shape for the MXU workload (fresh per-window
+    embeddings pushed to N tenants' subscriptions): each tenant owns a
+    `[vb+1, F]` feature slab; pump rounds stack the ready tenants'
+    full windows into `[N, W, eb]` slabs and fold them through
+    ops/gnn_window.build_gnn_cohort_scan under one `gnn_cohort`
+    program per power-of-two (tenants, windows) bucket. The cohort
+    shares ONE snapped weight layer (the production recommendation
+    shape — one model, many streams); per-tenant results are
+    bit-identical to N separate GnnSummaryEngine runs by the lattice
+    argument plus the cohort scan's live-window mask (tools/gnn_ab.py
+    asserts it), and `tenant_state_dict()` is interchangeable with the
+    GNN engines' load_state_dict at equal buckets and feature width —
+    the cohort→single and cohort→host demotion ladders are layout
+    conversions, not translations.
+
+    Deliberately a focused scheduler next to TenantCohort: admission
+    cap and typed rejects, per-tenant queues and window accounting,
+    the bucketed program cache — without the analytics cohort's
+    residency/quarantine/autotune machinery, which is specialized to
+    the 3-slab analytics carry. Those rungs graduate here the same way
+    they did there: behind committed A/B rows."""
+
+    def __init__(self, edge_bucket: int, vertex_bucket: int,
+                 feature_dim: int = None, activation: str = None):
+        from ..ops import gnn_window as gnn_ops
+        from ..ops import pallas_window
+
+        self._gnn = gnn_ops
+        self._pallas_window = pallas_window
+        self.eb = seg_ops.bucket_size(edge_bucket)
+        self.vb = seg_ops.bucket_size(vertex_bucket)
+        self.F = int(feature_dim if feature_dim
+                     else knobs.get_int("GS_GNN_F"))
+        self.act = str(activation if activation
+                       else (knobs.get_str("GS_GNN_ACT") or "relu"))
+        self._w_units, self._b_units = gnn_ops.snap_weights(
+            *gnn_ops.default_weights(self.F), self.F)
+        self._wdev = None  # refreshed lazily after set_weights
+        self._bdev = None
+        self._tenants: Dict[str, dict] = {}
+        self._order: List[str] = []
+        self._programs: Dict[tuple, object] = {}
+        self._lock = threading.RLock()
+
+    # -- membership ----------------------------------------------------
+    def admit(self, tenant_id, features=None,
+              feature_units=None) -> None:
+        tid = str(tenant_id)
+        with self._lock:
+            if tid in self._tenants:
+                raise TenantRejected("tenant %r already admitted"
+                                     % tid, tid)
+            if len(self._tenants) >= max_tenants():
+                raise TenantRejected(
+                    "cohort full: GS_TENANT_MAX=%d tenants admitted"
+                    % max_tenants(), tid)
+            if feature_units is not None:
+                slab = np.asarray(feature_units, np.float32)  # gslint: disable=host-sync (host-input normalization: admit payloads are numpy)
+                if slab.shape != (self.vb + 1, self.F):
+                    raise ValueError(
+                        "unit slab must be [vb+1=%d, F=%d]; got %s"
+                        % (self.vb + 1, self.F, slab.shape))
+            elif features is not None:
+                slab = self._gnn.snap_features(features, self.vb,
+                                               self.F)
+            else:
+                slab = np.zeros((self.vb + 1, self.F), np.float32)
+            self._tenants[tid] = {
+                "carry": jnp.asarray(slab),
+                "src": [], "dst": [], "queued": 0,
+                "windows_done": 0,
+            }
+            self._order.append(tid)
+        telemetry.event("tenant_admitted", tenant=tid,
+                        workload="gnn")
+
+    def _tenant(self, tenant_id) -> dict:
+        t = self._tenants.get(str(tenant_id))
+        if t is None:
+            raise TenantError("unknown tenant %r" % tenant_id,
+                              str(tenant_id))
+        return t
+
+    # -- weights -------------------------------------------------------
+    def set_weights(self, W, b=None) -> None:
+        """Adopt the cohort's shared dense layer, snapped onto the
+        lattice (ops/gnn_window.snap_weights — the bit-exactness
+        contract). Never recompiles: weights ride every dispatch as
+        broadcast arguments."""
+        if b is None:
+            b = np.zeros(self.F, np.float32)
+        with self._lock:
+            self._w_units, self._b_units = self._gnn.snap_weights(
+                W, b, self.F)
+            self._wdev = None
+
+    def weights(self):
+        return self._w_units.copy(), self._b_units.copy()
+
+    # -- ingest --------------------------------------------------------
+    def feed(self, tenant_id, src, dst) -> int:
+        src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: feed payloads are numpy)
+        dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: feed payloads are numpy)
+        with self._lock:
+            t = self._tenant(tenant_id)
+            t["src"].append(src)
+            t["dst"].append(dst)
+            t["queued"] += len(src)
+            return t["queued"]
+
+    def queued_edges(self, tenant_id) -> int:
+        return self._tenant(tenant_id)["queued"]
+
+    def windows_done(self, tenant_id) -> int:
+        return self._tenant(tenant_id)["windows_done"]
+
+    # -- the dispatch --------------------------------------------------
+    def _program(self, nb: int, wb: int):
+        """One jitted cohort program per power-of-two (tenants,
+        windows) bucket — ragged cohorts reuse O(log N × log W)
+        programs. Wrapped by the compile watch / cost observatory as
+        `gnn_cohort`; the analytic slab model registers at the same
+        label (armed only) so ledger spans join a stated cost."""
+        key = (nb, wb)
+        fn = self._programs.get(key)
+        if fn is None:
+            import jax
+
+            run = self._gnn.build_gnn_cohort_scan(
+                self.eb, self.vb, self.F, self.act)
+            fn = self._programs[key] = metrics.wrap_jit(
+                "gnn_cohort", jax.jit(run))
+            self._pallas_window.register_gnn_cost_model(
+                self.eb, self.vb, self.F, nb=nb)
+        return fn
+
+    def _take_windows(self, t: dict, drain: bool):
+        """Cut the tenant's queue at the window boundary: every FULL
+        window now, the sub-window remainder only when draining
+        (close) — the same cut GnnSummaryEngine.process makes, so the
+        per-tenant window sequence matches the single-engine run
+        edge-for-edge."""
+        if not t["queued"]:
+            return None
+        src = np.concatenate(t["src"]) if len(t["src"]) != 1 \
+            else t["src"][0]
+        dst = np.concatenate(t["dst"]) if len(t["dst"]) != 1 \
+            else t["dst"][0]
+        take = len(src) if drain else (len(src) // self.eb) * self.eb
+        if not take:
+            return None
+        t["src"] = [src[take:]] if take < len(src) else []
+        t["dst"] = [dst[take:]] if take < len(src) else []
+        t["queued"] = len(src) - take
+        return seg_ops.window_stack(src[:take], dst[:take], self.eb,
+                                    sentinel=self.vb)
+
+    def _dispatch(self, batch: List[str], taken: dict,
+                  out: Dict[str, list]) -> None:
+        import jax
+
+        nb = seg_ops.bucket_size(len(batch))
+        wb = seg_ops.bucket_size(max(t[0] for t in taken.values()))
+        src = np.full((nb, wb, self.eb), self.vb, np.int32)
+        dst = np.full((nb, wb, self.eb), self.vb, np.int32)
+        valid = np.zeros((nb, wb, self.eb), bool)
+        carries = []
+        for i, tid in enumerate(batch):
+            num_w, s, d, v = taken[tid]
+            src[i, :num_w] = s
+            dst[i, :num_w] = d
+            valid[i, :num_w] = v
+            carries.append(self._tenants[tid]["carry"])
+        zero = jnp.zeros((self.vb + 1, self.F), jnp.float32)
+        carries.extend([zero] * (nb - len(batch)))
+        if self._wdev is None:
+            self._wdev = jnp.asarray(self._w_units)
+            self._bdev = jnp.asarray(self._b_units)
+        # padded rows/windows are all-invalid and therefore inert
+        # (the round's empty-window-holds rule); their summary rows
+        # are dropped below
+        hs, ys = self._program(nb, wb)(
+            jnp.stack(carries), self._wdev, self._bdev,
+            jnp.asarray(src), jnp.asarray(dst), jnp.asarray(valid))
+        maxf, active, csum, nmsg = (np.array(y) for y in ys)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per pump round)
+        for i, tid in enumerate(batch):
+            t = self._tenants[tid]
+            t["carry"] = hs[i]
+            num_w = taken[tid][0]
+            rows = out.setdefault(tid, [])
+            for w in range(num_w):
+                rows.append({
+                    "max_feat": int(maxf[i, w]),  # gslint: disable=host-sync (numpy-on-numpy after the batched d2h)
+                    "active_vertices": int(active[i, w]),  # gslint: disable=host-sync (numpy-on-numpy after the batched d2h)
+                    "feat_checksum": int(csum[i, w]),  # gslint: disable=host-sync (numpy-on-numpy after the batched d2h)
+                    "msg_edges": int(nmsg[i, w]),  # gslint: disable=host-sync (numpy-on-numpy after the batched d2h)
+                })
+            edges = int(np.sum(taken[tid][3]))  # gslint: disable=host-sync (numpy-on-numpy: the host-built validity stack)
+            t["windows_done"] += num_w
+            metrics.mark_window(num_w, edges, engine="GnnTenantCohort",
+                                tier="gnn_cohort", tenant=tid)
+
+    def pump(self) -> Dict[str, list]:
+        """Fold every tenant's FULL queued windows in one vmapped
+        dispatch; returns {tenant_id: [summary, ...]} for the windows
+        folded this round. Sub-window remainders stay queued for the
+        next feed or close — window cuts are the engine's."""
+        with self._lock:
+            taken = {}
+            batch = []
+            for tid in self._order:
+                got = self._take_windows(self._tenants[tid],
+                                         drain=False)
+                if got is not None:
+                    taken[tid] = got
+                    batch.append(tid)
+            out: Dict[str, list] = {}
+            if batch:
+                self._dispatch(batch, taken, out)
+            return out
+
+    def close(self, tenant_id) -> List[dict]:
+        """Drain the tenant's remainder (its final padded window, if
+        any), remove it from the cohort, return the last summaries.
+        The carry is gone afterwards — checkpoint first
+        (tenant_state_dict) to keep the slab."""
+        tid = str(tenant_id)
+        with self._lock:
+            t = self._tenant(tid)
+            out: Dict[str, list] = {}
+            got = self._take_windows(t, drain=True)
+            if got is not None:
+                self._dispatch([tid], {tid: got}, out)
+            del self._tenants[tid]
+            self._order.remove(tid)
+            return out.get(tid, [])
+
+    # -- checkpoint / demotion ladder ----------------------------------
+    def tenant_state_dict(self, tenant_id) -> dict:
+        """One tenant's slice in the GNN ENGINE checkpoint layout —
+        loadable by GnnSummaryEngine/GnnHostEngine.load_state_dict at
+        equal buckets and feature width (the demotion ladder)."""
+        with self._lock:
+            t = self._tenant(tenant_id)
+            h = np.array(t["carry"])  # gslint: disable=host-sync (sanctioned checkpoint boundary: tenant_state_dict's one d2h)
+            return {
+                "edge_bucket": self.eb,
+                "vertex_bucket": self.vb,
+                "windows_done": int(t["windows_done"]),  # gslint: disable=host-sync (cohort bookkeeping int, no device value in sight)
+                "closed_partial": False,
+                "wal_offset": int(t["windows_done"]) * self.eb,  # gslint: disable=host-sync (cohort bookkeeping int, no device value in sight)
+                "carry": (h,),
+                "gnn": {
+                    "feat_dim": self.F,
+                    "act": self.act,
+                    "weights": self._w_units.copy(),
+                    "bias": self._b_units.copy(),
+                },
+            }
+
+    def load_tenant_state_dict(self, tenant_id, state: dict) -> None:
+        """Adopt an engine checkpoint as a tenant's slab (the
+        promotion direction of the same ladder)."""
+        g = state.get("gnn") or {}
+        if (int(state["edge_bucket"]) != self.eb  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
+                or int(state["vertex_bucket"]) != self.vb  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
+                or int(g.get("feat_dim", self.F)) != self.F):  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
+            raise ValueError(
+                "checkpoint shape (eb=%s, vb=%s, F=%s) does not match "
+                "cohort (eb=%d, vb=%d, F=%d)"
+                % (state.get("edge_bucket"), state.get("vertex_bucket"),
+                   g.get("feat_dim"), self.eb, self.vb, self.F))
+        with self._lock:
+            t = self._tenant(tenant_id)
+            (h,) = state["carry"]
+            t["carry"] = jnp.asarray(np.asarray(h, np.float32))  # gslint: disable=host-sync (host-input normalization: checkpoint payloads are numpy)
+            t["windows_done"] = int(state.get("windows_done", 0))  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
+
+    def demote(self, tenant_id):
+        """Pop the tenant out of the cohort onto its own
+        GnnSummaryEngine, seeded from its live slab — per-tenant
+        isolation without touching the cohort's other streams.
+
+        Returns ``(engine, folded, (src, dst))``: FULL queued windows
+        are folded through the engine during the hand-off and their
+        summaries returned (never dropped); the sub-window remainder
+        comes back UNFOLDED — prepend it to the continued stream so
+        window cuts stay edge-for-edge with the no-demotion timeline
+        (the engine's process() would CLOSE a partial trailing
+        window, which only a stream's end may do)."""
+        tid = str(tenant_id)
+        with self._lock:
+            t = self._tenant(tid)
+            state = self.tenant_state_dict(tid)
+            pend_s = (np.concatenate(t["src"]) if t["src"]
+                      else np.empty(0, np.int32))
+            pend_d = (np.concatenate(t["dst"]) if t["dst"]
+                      else np.empty(0, np.int32))
+            del self._tenants[tid]
+            self._order.remove(tid)
+        eng = self._gnn.GnnSummaryEngine(
+            self.eb, self.vb, feature_dim=self.F,
+            activation=self.act)
+        eng.load_state_dict(state)
+        resilience.record_demotion(
+            "tenant:%s" % tid, "gnn_cohort", "gnn_scan",
+            int(state["windows_done"]), "operator", tenant=tid)  # gslint: disable=host-sync (checkpoint payloads are host numpy, never device values)
+        full = (len(pend_s) // self.eb) * self.eb
+        folded = eng.process(pend_s[:full], pend_d[:full]) \
+            if full else []
+        return eng, folded, (pend_s[full:], pend_d[full:])
+
+    def tenants(self) -> List[str]:
+        return list(self._order)
+
+    def state(self, tenant_id) -> np.ndarray:
+        """[vb, F] feature snapshot in lattice units."""
+        with self._lock:
+            t = self._tenant(tenant_id)
+            return np.asarray(t["carry"])[: self.vb].copy()  # gslint: disable=host-sync (sanctioned snapshot boundary: the cohort's state() d2h)

@@ -78,6 +78,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -112,13 +113,15 @@ _PROBES = {}  # (vb,kb,kind) -> bool probe verdict  # gslint: disable=thread-sha
 # ----------------------------------------------------------------------
 _PALLAS = None  # "pallas" | "xla", resolved once per process
 _COHORT_PALLAS = None  # "pallas" | "xla", resolved once per process
+_GNN_PALLAS = None  # "pallas" | "xla", resolved once per process
 
 
 def _reset_pallas_window() -> None:
     """Test hook: forget the memoized selections and probe verdicts."""
-    global _PALLAS, _COHORT_PALLAS
+    global _PALLAS, _COHORT_PALLAS, _GNN_PALLAS
     _PALLAS = None
     _COHORT_PALLAS = None
+    _GNN_PALLAS = None
     _PROBES.clear()
 
 
@@ -186,6 +189,39 @@ def resolve_cohort_pallas() -> bool:
                             error="%s: %s" % (type(e).__name__, e))
         _COHORT_PALLAS = impl
     return _COHORT_PALLAS == "pallas"
+
+
+def resolve_gnn_pallas() -> bool:
+    """Should the GNN engines (ops/gnn_window.py) run the fused
+    Pallas GNN window kernel instead of the XLA gather/segment-sum
+    round? GS_GNN_PALLAS pins (`on`/`off`); unset/`auto` adopts only
+    when committed backend-matched `gnn_ab` rows with probe
+    `gnn_pallas` — NON-interpret rows only — ALL show exact parity
+    and ≥1.05× (ops/triangles.rows_clear_bar, the repo-wide
+    measured-adoption policy). CPU feature slabs stay bit-identical
+    until a chip row lands. Memoized per process."""
+    global _GNN_PALLAS
+    pin = knobs.get_str("GS_GNN_PALLAS")
+    if pin == "on":
+        return True
+    if pin == "off":
+        return False
+    if _GNN_PALLAS is None:
+        impl = "xla"
+        try:
+            perf = tri_ops._load_matching_perf()
+            rows = [r for r in (perf or {}).get("gnn_ab", [])
+                    if r.get("probe") == "gnn_pallas"
+                    and not r.get("interpret")]
+            if tri_ops.rows_clear_bar(rows, "speedup",
+                                      lambda r: 1.0):
+                impl = "pallas"
+        except Exception as e:
+            telemetry.event("selection.fallback", durable=True,
+                            component="gnn_pallas", fallback=impl,
+                            error="%s: %s" % (type(e).__name__, e))
+        _GNN_PALLAS = impl
+    return _GNN_PALLAS == "pallas"
 
 
 # ----------------------------------------------------------------------
@@ -949,6 +985,276 @@ def maybe_compact_scan_fn(eb: int, vb: int, kb: int, label: str,
 
     return metrics.wrap_jit(label, jax.jit(run_pc,
                                            **(jit_kwargs or {})))
+
+
+# ----------------------------------------------------------------------
+# the GNN window kernel (ops/gnn_window's fused round)
+# ----------------------------------------------------------------------
+def gnn_h_bytes(vb: int, F: int) -> int:
+    """Bytes of one [vb+1, F] float32 feature-slab copy."""
+    return 4 * (vb + 1) * F
+
+
+def gnn_weight_bytes(F: int) -> int:
+    """Bytes of the dense layer's W [F, F] + b [F] (float32)."""
+    return 4 * F * (F + 1)
+
+
+def gnn_window_flops(eb: int, vb: int, F: int) -> int:
+    """Stated-model FLOP estimate for ONE GNN round (labeled
+    `analytic` in the cost registry): the dense update's matmul
+    dominates (2·(vb+1)·F²) — THE term no other program here has —
+    plus the aggregation's gather-and-add (2·eb·F) and the clamp/act
+    elementwise sweeps (~6·(vb+1)·F)."""
+    return (2 * (vb + 1) * F * F + 2 * eb * F
+            + 6 * (vb + 1) * F)
+
+
+def gnn_window_bytes(eb: int, vb: int, F: int) -> int:
+    """The fused kernel's HBM traffic per round: ONE standard-wire
+    slab read (the features ride the same read as the megakernel's
+    analytics — the messages never round-trip HBM), the feature slab
+    read+write, the weights, the summary row."""
+    return (slab_bytes(eb, False) + 2 * gnn_h_bytes(vb, F)
+            + gnn_weight_bytes(F) + 4 * _SUMS)
+
+
+def gnn_scan_bytes(eb: int, vb: int, F: int) -> int:
+    """HBM bytes the XLA gather/segment-sum round moves for the SAME
+    window: the slab read, the materialized [eb, F] message matrix's
+    write+read (gather out, segment-sum in), the [vb+1, F] aggregate's
+    write+read, the feature slab's read+write, and the weights. The
+    adoption story is the same subtraction as scan_of_gathers_bytes:
+    the fused kernel deletes the message-matrix round-trip."""
+    msgs = 4 * eb * F
+    return (slab_bytes(eb, False) + 2 * msgs
+            + 2 * gnn_h_bytes(vb, F) + 2 * gnn_h_bytes(vb, F)
+            + gnn_weight_bytes(F))
+
+
+def gnn_vmem_window_bytes(eb: int, vb: int, F: int,
+                          tile_e: int = None) -> int:
+    """The GNN kernel's VMEM high-water estimate (DESIGN.md §23
+    mirrors §19's walk): decoded slab scratch, the feature slab in
+    and out plus the gathered message matrix and the aggregate /
+    pre-activation temporaries (~4 slab-sized blocks), and the
+    weights."""
+    slab = 2 * 4 * eb
+    msgs = 4 * eb * F
+    return (slab + 4 * gnn_h_bytes(vb, F) + msgs
+            + gnn_weight_bytes(F))
+
+
+def supports_gnn(eb: int, vb: int, F: int,
+                 tile_e: int = None) -> bool:
+    """Does a GNN round at (eb, vb, F) fit the chip's VMEM budget?
+    Same contract as supports(): enforced on TPU backends only —
+    interpret mode has no VMEM, and refusing a CPU parity run over a
+    budget the backend doesn't have would gate the oracle out of
+    existence."""
+    if not _on_tpu():
+        return True
+    return gnn_vmem_window_bytes(eb, vb, F, tile_e) <= VMEM_BUDGET
+
+
+def register_gnn_cost_model(eb: int, vb: int, F: int,
+                            nb: int = None) -> None:
+    """Register the GNN programs' analytic cost models with the
+    observatory (armed only) under every wrap_jit label the family
+    dispatches as: the XLA scan tiers (`gnn_scan`, `gnn_resident`)
+    at the gather/segment-sum byte model, the fused kernel
+    (`gnn_pallas`) at the single-slab-read model. With `nb` set,
+    registers the vmapped tenant-axis program (`gnn_cohort`) at
+    nb-scaled numbers instead. These are the repo's first MXU-class
+    rows — the flops term carries a matmul, so the stated arithmetic
+    intensity finally has a chance against machine balance."""
+    flops = gnn_window_flops(eb, vb, F)
+    sig = "eb=%d,vb=%d,F=%d" % (eb, vb, F)
+    if nb is not None:
+        costmodel.record_analytic(
+            "gnn_cohort", sig + (",nb=%d" % nb),
+            flops=nb * flops,
+            bytes_accessed=nb * gnn_scan_bytes(eb, vb, F),
+            slab_bytes=nb * slab_bytes(eb, False),
+            model="analytic",
+            # PER WINDOW ROUND (one window × nb tenants)
+            unit="window")
+        return
+    for program, nbytes in (
+            ("gnn_scan", gnn_scan_bytes(eb, vb, F)),
+            ("gnn_resident", gnn_scan_bytes(eb, vb, F)),
+            ("gnn_pallas", gnn_window_bytes(eb, vb, F))):
+        costmodel.record_analytic(
+            program, sig,
+            flops=flops,
+            bytes_accessed=nbytes,
+            slab_bytes=slab_bytes(eb, False),
+            model="analytic",
+            # the model is PER WINDOW; a chunk dispatch folds W of
+            # them, so a reader scaling against per-dispatch span
+            # seconds multiplies by the sig's leading window count
+            unit="window")
+
+
+def _gnn_call(eb: int, vb: int, F: int, act: str, tile_e: int,
+              interpret: bool):
+    """The fused GNN-round pallas_call closure:
+    (h[vb+1,F], W[F,F], b[F], s2, d2, v2) -> (h', sums[8]). Stages
+    the sentinel-mapped slab tile by tile into VMEM scratch; the last
+    tile runs the whole round — gather, scatter-accumulate, clamp,
+    the MXU dot at Precision.HIGHEST, activation, re-clip — against
+    the VMEM-resident feature slab, then packs the four summary
+    scalars. Bit-identical to ops/gnn_window._build_gnn_round by the
+    lattice argument (every intermediate an exact float32 integer
+    < 2^24, so fold order is free). Memoized per shape."""
+    key = (eb, vb, F, act, tile_e, "g", interpret)
+    got = _CALLS.get(key)
+    if got is not None:
+        return got
+    from . import gnn_window as gw
+
+    g = eb // tile_e
+    sent = vb
+    sh = gw.agg_shift(eb)
+    sc = np.float32(2.0 ** -sh)
+    cap = np.float32(gw.UNIT_CAP)
+    actf = gw._ACTS_JNP[act]
+
+    def kernel(s_ref, d_ref, v_ref, h0_ref, w_ref, b_ref,
+               h_ref, sums_ref, slab_s, slab_d):
+        i = pl.program_id(0)
+        v = v_ref[0, :]
+        slab_s[i, :] = jnp.where(v, s_ref[0, :], sent)
+        slab_d[i, :] = jnp.where(v, d_ref[0, :], sent)
+
+        @pl.when(i == g - 1)
+        def _():
+            sa = slab_s[:].reshape(eb)
+            da = slab_d[:].reshape(eb)
+            h = h0_ref[:]
+            msgs = h[sa]
+            if sh:
+                msgs = jnp.floor(msgs * sc)
+            m = jnp.zeros((vb + 1, F), jnp.float32).at[da].add(msgs)
+            p = jnp.minimum(h + jnp.minimum(m, cap), cap)
+            z = jax.lax.dot_general(
+                p, w_ref[:], (((1,), (0,)), ((), ())),
+                precision=jax.lax.Precision.HIGHEST) + b_ref[:]
+            h2 = jnp.clip(actf(z), 0.0, cap)
+            h2 = h2.at[sent].set(0.0)
+            # the round's empty-window-holds rule (_build_gnn_round):
+            # zero valid edges → the slab carries through untouched
+            nmsg = jnp.sum(sa != sent, dtype=jnp.int32)
+            h2 = jnp.where(nmsg > 0, h2, h)
+            h_ref[:] = h2
+            maxf = jnp.max(h2[:vb]).astype(jnp.int32)
+            active = jnp.sum(jnp.any(h2[:vb] > 0, axis=1),
+                             dtype=jnp.int32)
+            checksum = jnp.sum(h2.astype(jnp.int32),
+                               dtype=jnp.int32)
+            sums_ref[:] = _pack_sums(maxf, active, checksum, nmsg)
+
+    tile_spec = pl.BlockSpec((1, tile_e), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+    h_spec = pl.BlockSpec((vb + 1, F), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    w_spec = pl.BlockSpec((F, F), lambda i: (0, 0),
+                          memory_space=pltpu.VMEM)
+    b_spec = pl.BlockSpec((F,), lambda i: (0,),
+                          memory_space=pltpu.VMEM)
+    call = pl.pallas_call(
+        kernel,
+        grid=(g,),
+        in_specs=[tile_spec, tile_spec, tile_spec,
+                  h_spec, w_spec, b_spec],
+        out_specs=[h_spec,
+                   pl.BlockSpec((_SUMS,), lambda i: (0,),
+                                memory_space=pltpu.SMEM)],
+        out_shape=[
+            jax.ShapeDtypeStruct((vb + 1, F), jnp.float32),
+            jax.ShapeDtypeStruct((_SUMS,), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.VMEM((g, tile_e), jnp.int32),
+                        pltpu.VMEM((g, tile_e), jnp.int32)],
+        interpret=interpret,
+        cost_estimate=pl.CostEstimate(
+            flops=gnn_window_flops(eb, vb, F),
+            bytes_accessed=gnn_window_bytes(eb, vb, F),
+            transcendentals=0),
+    )
+
+    def run(h, W, b, *wire):
+        return call(*wire, h, W, b)
+
+    _CALLS[key] = run
+    return run
+
+
+def build_gnn_window_body(eb: int, vb: int, F: int, act: str,
+                          tile_e: int = None,
+                          interpret: bool = None):
+    """The fused GNN round as a drop-in body for
+    ops/gnn_window._build_gnn_scan: body(h, W, b, xs) with the same
+    [vb+1, F] carry and (max_feat, active, checksum, msg_edges)
+    outputs as the XLA round — interchangeable under lax.scan by the
+    lattice argument. Standard wire only."""
+    if tile_e is None:
+        tile_e = default_tile(eb)
+    if interpret is None:
+        interpret = _need_interpret()
+    run = _gnn_call(eb, vb, F, act, tile_e, interpret)
+    g = eb // tile_e
+
+    def body(h, W, b, xs):
+        src, dst, valid = xs
+        h, sums = run(h, W, b, src.reshape(g, tile_e),
+                      dst.reshape(g, tile_e),
+                      valid.reshape(g, tile_e))
+        return h, (sums[0], sums[1], sums[2], sums[3])
+
+    body.gnn_pallas = True
+    return body
+
+
+def maybe_gnn_body(eb: int, vb: int, F: int, act: str):
+    """The gated, PROBED entry GnnSummaryEngine builds through: None
+    (use the XLA gather/segment-sum round) unless
+    resolve_gnn_pallas() is on, the [vb+1, F] slab fits the chip
+    budget, AND a trace probe of the built body succeeds — the same
+    durable `selection.fallback` contract as maybe_window_body, under
+    component `gnn_pallas`. On success the GNN analytic cost entries
+    register with the observatory."""
+    if not resolve_gnn_pallas():
+        return None
+    tile_e = default_tile(eb)
+    if not supports_gnn(eb, vb, F, tile_e):
+        telemetry.event("selection.fallback", durable=True,
+                        component="gnn_pallas",
+                        fallback="xla_gnn_scan",
+                        error="vmem budget: %d > %d at eb=%d vb=%d "
+                              "F=%d" % (
+                                  gnn_vmem_window_bytes(eb, vb, F,
+                                                        tile_e),
+                                  VMEM_BUDGET, eb, vb, F))
+        return None
+    try:
+        body = build_gnn_window_body(eb, vb, F, act, tile_e)
+        h = jax.ShapeDtypeStruct((vb + 1, F), jnp.float32)
+        W = jax.ShapeDtypeStruct((F, F), jnp.float32)
+        b = jax.ShapeDtypeStruct((F,), jnp.float32)
+        xs = (jax.ShapeDtypeStruct((eb,), jnp.int32),
+              jax.ShapeDtypeStruct((eb,), jnp.int32),
+              jax.ShapeDtypeStruct((eb,), jnp.bool_))
+        jax.eval_shape(body, h, W, b, xs)
+    except Exception as e:
+        telemetry.event("selection.fallback", durable=True,
+                        component="gnn_pallas",
+                        fallback="xla_gnn_scan",
+                        error="%s: %s" % (type(e).__name__,
+                                          str(e)[:200]))
+        return None
+    register_gnn_cost_model(eb, vb, F)
+    return body
 
 
 def maybe_counter(vb: int, kb: int, classic_run):

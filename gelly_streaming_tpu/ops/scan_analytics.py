@@ -279,13 +279,13 @@ class SummaryEngineBase:
     # checkpoint / resume (utils/checkpoint.py)
     # ------------------------------------------------------------------
     def state_dict(self) -> dict:
-        """Full resumable state: the three carried vectors (d2h'd to
-        host arrays) plus the windows_done cursor. The layout is the
+        """Full resumable state: the carried vectors (d2h'd to host
+        arrays) plus the windows_done cursor. The layout is the
         carry's own, shared by the single-chip and sharded engines, so
         checkpoints are engine-interchangeable at equal buckets. When
         the online tuner is live, its learned state rides along so a
         resumed stream keeps its configuration."""
-        deg, labels, cover = (np.array(x) for x in self._carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: state_dict's one d2h)
+        carry = tuple(np.array(x) for x in self._carry)  # gslint: disable=host-sync (sanctioned checkpoint boundary: state_dict's one d2h)
         state = {
             "edge_bucket": self.eb,
             "vertex_bucket": self.vb,
@@ -295,7 +295,7 @@ class SummaryEngineBase:
             # folded into the carry): resume_and_replay() re-feeds the
             # WAL strictly past it (DESIGN.md §18)
             "wal_offset": int(self.windows_done) * self.eb,
-            "carry": (deg, labels, cover),
+            "carry": carry,
         }
         if getattr(self, "_tuner", None) is not None:
             state["autotune"] = self._tuner.state_dict()

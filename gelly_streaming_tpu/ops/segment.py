@@ -236,8 +236,8 @@ def window_stack(src: np.ndarray, dst: np.ndarray, eb: int,
     [W, eb] stacks plus the validity mask — the shared layout of every
     batched window dispatch (triangles.count_stream, sharded
     count_stream, scan_analytics.process)."""
-    src = np.asarray(src, np.int32)
-    dst = np.asarray(dst, np.int32)
+    src = np.asarray(src, np.int32)  # gslint: disable=host-sync (host-input normalization: stream payloads are numpy, never device values)
+    dst = np.asarray(dst, np.int32)  # gslint: disable=host-sync (host-input normalization: stream payloads are numpy, never device values)
     n = len(src)
     num_w = -(-n // eb)
     s = pad_to(src, num_w * eb, fill=sentinel).reshape(num_w, eb)

@@ -150,8 +150,8 @@ def double_cover_edges(src: np.ndarray, dst: np.ndarray,
     """Build the bipartite double cover's edge list: (u,+)=u, (u,-)=u+v;
     edge u~w joins (u,+)-(w,-) and (u,-)-(w,+). Shared by the host
     and sharded bipartiteness paths."""
-    src = np.asarray(src, np.int64)
-    dst = np.asarray(dst, np.int64)
+    src = np.asarray(src, np.int64)  # gslint: disable=host-sync (host-input normalization: cover construction is numpy-on-numpy)
+    dst = np.asarray(dst, np.int64)  # gslint: disable=host-sync (host-input normalization: cover construction is numpy-on-numpy)
     v = num_vertices
     return np.concatenate([src, src + v]), np.concatenate([dst + v, dst])
 

@@ -580,6 +580,31 @@ register("GS_COSTMODEL_PEAK_GBPS", "float", 819.0, lo=0.001,
               "public TPU v5e HBM peak",
          default_text="819 (v5e HBM)")
 
+# windowed GNN workload (ops/gnn_window.py)
+register("GS_GNN_F", "int", 16, lo=1, hi=256,
+         help="feature width F of the windowed GNN workload's "
+              "per-vertex slab (`ops/gnn_window.py`); engines built "
+              "without an explicit feature_dim read it at "
+              "construction. F ≤ 64 keeps the dense update exactly "
+              "representable on the storage lattice; larger F snaps "
+              "weights to a coarser grid (same deterministic shift "
+              "on every tier, so parity holds)")
+register("GS_GNN_ACT", "str", "relu", choices=("relu", "abs",
+                                               "identity"),
+         help="activation of the GNN dense update — restricted to "
+              "EXACT elementwise ops (relu/abs/identity) so the "
+              "numpy twin stays a bit-exactness oracle; read at "
+              "engine construction")
+register("GS_GNN_PALLAS", "str", "", choices=("on", "off", "auto"),
+         help="pin the fused Pallas GNN window kernel "
+              "(`ops/pallas_window.maybe_gnn_body`): `on` forces it "
+              "(interpret mode off-TPU), `off` never selects it; "
+              "unset/`auto` = adopt only on committed parity+≥1.05× "
+              "non-interpret `gnn_ab` rows with probe `gnn_pallas` "
+              "— the XLA gather/segment-sum body stands until a "
+              "chip row lands",
+         default_text="auto")
+
 
 # ----------------------------------------------------------------------
 # docs rendering (README table; gslint R3 diffs it back)

@@ -57,6 +57,7 @@ RATE_FIELDS = (
     "armed_edges_per_s", "disarmed_edges_per_s", "edges_per_s",
     "resident_edges_per_s", "perwindow_edges_per_s",
     "tenant_edges_per_s", "sequential_edges_per_s",
+    "gnn_edge_features_per_s", "cohort_edges_per_s",
 )
 RATIO_FIELDS = ("pipeline_speedup", "speedup", "vs_baseline",
                 "cohort_speedup", "queue_wait_improvement",
@@ -88,6 +89,7 @@ PERF_SECTIONS = {
     "resident_ab": ("probe",),
     "tenancy_ab": ("probe", "tenants"),
     "pump_ab": ("probe",),
+    "gnn_ab": ("probe", "tenants"),
     "autotune": ("engine", "edge_bucket"),
 }
 

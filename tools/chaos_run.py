@@ -25,6 +25,13 @@ Schedule (all deterministic, utils/faults — no randomness anywhere):
             · 1 fatal kill mid-call → fresh engine resumes from its
               auto-checkpoint, positional combine
 
+  leg G — the GNN drill (ops/gnn_window): a journal-armed
+          GnnSummaryEngine killed fatally mid-stream → newest
+          checkpoint + WAL-suffix replay → summary stream AND the
+          final [vb, F] feature slab bit-identical to the fault-free
+          oracle (the dyadic-lattice exactness contract survives the
+          crash)
+
   leg R — the RESIDENT drill: the driver pinned to the resident
           megakernel (ops/resident_engine), fatal kill MID-SUPERBATCH
           → auto-checkpoint resume → window-by-window sha256 parity
@@ -228,6 +235,90 @@ def leg_engine(src, dst, eb: int, vb: int, num_w: int,
         "windows": num_w,
         "killed_at_call": killed_at,
         "resumed_from_window": off // eb,
+        "faults_fired": [list(f) for f in fired],
+        "parity": True,
+    }
+
+
+def leg_gnn(workdir: str) -> dict:
+    """The windowed-GNN leg: a journal-armed GnnSummaryEngine killed
+    fatally mid-stream → newest checkpoint + WAL-suffix replay → the
+    summary stream AND the final [vb, F] feature slab bit-identical
+    to the fault-free oracle. The dyadic-lattice exactness contract
+    (ops/gnn_window) must survive a crash, not just a clean run: a
+    replayed dense update that drifted by one lattice unit would
+    flip the slab digest here."""
+    from gelly_streaming_tpu.ops import gnn_window as gw
+
+    eb, vb, F, num_w = 512, 2048, 16, 8
+    src, dst = make_stream(num_w * eb, vb, seed=29)
+    src = np.asarray(src, np.int32)
+    dst = np.asarray(dst, np.int32)
+    rngw = np.random.RandomState(11)
+    W, bias = rngw.randn(F, F) * 0.3, rngw.randn(F) * 0.1
+
+    def make():
+        eng = gw.GnnSummaryEngine(eb, vb, feature_dim=F)
+        eng.set_weights(W, bias)
+        eng.load_feature_units(gw.default_features(vb, F, seed=3))
+        return eng
+
+    oracle = make()
+    baseline = oracle.process(src, dst)
+    oracle_slab = oracle.state()
+
+    gdir = os.path.join(workdir, "gnn")
+    os.makedirs(gdir, exist_ok=True)
+    ckpt = os.path.join(gdir, "gnn.npz")
+    eng = make()
+    eng.enable_wal(gdir, tenant="gnn")
+    eng.enable_auto_checkpoint(ckpt, every_n_windows=2)
+    call_w = 4
+    fired = []
+    out = []
+    plans = {
+        1: [faults.FaultSpec(site="dispatch", on_call=1, fatal=True)],
+    }
+    killed_at = None
+    for call, lo in enumerate(range(0, num_w, call_w)):
+        s = src[lo * eb:(lo + call_w) * eb]
+        d = dst[lo * eb:(lo + call_w) * eb]
+        try:
+            with faults.inject(*plans.get(call, [])) as plan:
+                out += eng.process(s, d)
+            fired += list(plan.fired)
+        except faults.InjectedFault:
+            fired += list(plan.fired)
+            killed_at = call
+            break
+    if killed_at is None:
+        raise SystemExit("chaos GNN leg: the kill never fired")
+    eng2 = make()
+    eng2.enable_wal(gdir, tenant="gnn")
+    if not eng2.try_resume(ckpt):
+        raise SystemExit("chaos GNN leg: no resumable checkpoint "
+                         "after the kill")
+    resumed_from = eng2.resume_offset() // eb
+    # resume_and_replay reloads the checkpoint itself, so the probe
+    # above cost nothing; the killed call's edges were journaled
+    # BEFORE the fold died, so the replay reproduces them
+    replayed = eng2.resume_and_replay(ckpt)
+    off = eng2.resume_offset()
+    rest = eng2.process(src[off:], dst[off:]) if off < num_w * eb \
+        else []
+    final = out[:resumed_from] + replayed + rest
+    if final != baseline:
+        raise SystemExit("chaos GNN leg: summaries DIVERGED from the "
+                         "fault-free run")
+    if not np.array_equal(eng2.state(), oracle_slab):
+        raise SystemExit("chaos GNN leg: feature slab DIVERGED from "
+                         "the fault-free oracle")
+    return {
+        "windows": num_w,
+        "feature_dim": F,
+        "killed_at_call": killed_at,
+        "resumed_from_window": resumed_from,
+        "replayed_windows": len(replayed),
         "faults_fired": [list(f) for f in fired],
         "parity": True,
     }
@@ -1781,6 +1872,10 @@ def main():
                 seed=13)
             b = leg_engine(b_src, b_dst, args.engine_eb, engine_vb,
                            args.engine_windows, workdir)
+            # GNN leg: the journal-armed windowed-GNN engine killed
+            # fatally mid-stream → checkpoint + WAL replay → summary
+            # stream AND feature slab ≡ the fault-free oracle
+            gn = leg_gnn(workdir)
             # health-plane leg: /healthz flips degraded on a stalled
             # h2d, recovers after the retry, durable events + armed
             # digest parity
@@ -1817,10 +1912,10 @@ def main():
             m = (leg_mesh(args.mesh_eb, 4096, args.mesh_windows,
                           args.mesh_devices, workdir)
                  if args.mesh_devices else None)
-            # flight-recorder leg: seven kills fired above (driver,
-            # autotune, resident, engine, tenancy, serve, pump) — the
-            # ledger must prove all
-            fr = assert_flight_recorder(num_kills=7)
+            # flight-recorder leg: eight kills fired above (driver,
+            # autotune, resident, engine, gnn, tenancy, serve, pump)
+            # — the ledger must prove all
+            fr = assert_flight_recorder(num_kills=8)
             fr["span_summary"] = telemetry.summary(top=12)
         finally:
             telemetry.reset()  # close the ledger inside the tempdir
@@ -1844,6 +1939,10 @@ def main():
         if site == "dispatch" and action == "raise":
             classes.add("resident_kill_resume")
     required.add("resident_kill_resume")
+    for site, _n, action in gn["faults_fired"]:
+        if site == "dispatch" and action == "raise":
+            classes.add("gnn_kill_replay")
+    required.add("gnn_kill_replay")
     for site, _n, action in tn["faults_fired"]:
         if site == "tenant_prep" and action == "raise":
             classes.add("tenant_demotion")
@@ -1903,6 +2002,7 @@ def main():
         "knobs": {k: os.environ.get(k, v) for k, v in KNOBS.items()},
         "driver_leg": a, "engine_leg": b, "autotune_leg": at,
         "resident_leg": rs,
+        "gnn_leg": gn,
         "health_leg": h,
         "tenancy_leg": tn,
         "serve_leg": sv,

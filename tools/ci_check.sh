@@ -49,6 +49,12 @@
 #      dispatch (overlap_feeds > 0, forced deterministically by a
 #      hung dispatch) — a pump that quietly serializes fails; plus
 #      the sliding default pin (slide == edge_bucket ≡ tumbling)
+#  12. windowed-GNN smoke (tools/gnn_smoke.py): one GNN round through
+#      the device engine AND the interpret-mode fused Pallas kernel
+#      must leave a feature slab + summary stream bit-identical to
+#      the numpy lattice twin — a broken lattice edit or a silently
+#      refused kernel probe fails the gate instead of passing
+#      vacuously
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -57,42 +63,45 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/11] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/12] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/11] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/12] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/11] gslint =="
+echo "== [2/12] gslint =="
 python -m tools.gslint
 
-echo "== [3/11] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/12] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/11] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/12] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/11] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/12] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
-echo "== [6/11] serve parity smoke (loopback + drain ≡ direct feed) =="
+echo "== [6/12] serve parity smoke (loopback + drain ≡ direct feed) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
-echo "== [7/11] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
+echo "== [7/12] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
 JAX_PLATFORMS=cpu python tools/pallas_smoke.py
 
-echo "== [8/11] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
+echo "== [8/12] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
 JAX_PLATFORMS=cpu python tools/latency_smoke.py
 
-echo "== [9/11] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
+echo "== [9/12] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
 JAX_PLATFORMS=cpu python tools/poison_smoke.py
 
-echo "== [10/11] cohort-resident smoke (resident tier ≡ single streams, no silent decline) =="
+echo "== [10/12] cohort-resident smoke (resident tier ≡ single streams, no silent decline) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --resident-smoke
 
-echo "== [11/11] async-pump smoke (async ≡ sync, real overlap; sliding pin) =="
+echo "== [11/12] async-pump smoke (async ≡ sync, real overlap; sliding pin) =="
 JAX_PLATFORMS=cpu python tools/pump_smoke.py
+
+echo "== [12/12] windowed-GNN smoke (device ≡ pallas ≡ numpy lattice twin) =="
+JAX_PLATFORMS=cpu python tools/gnn_smoke.py
 
 echo "ci_check: all gates green"

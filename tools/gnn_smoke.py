@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""CI gate [12/12]: windowed-GNN round smoke.
+
+One window through GnnSummaryEngine must leave a feature slab AND a
+summary stream bit-identical to the numpy host twin (the lattice
+bit-exactness oracle of ops/gnn_window) — so the static gate catches
+a broken lattice edit (a rescaled weight snap, a reordered clip, an
+aggregation that left the exact-shift regime) without a chip. A
+second leg pins the fused Pallas GNN kernel (GS_GNN_PALLAS=on,
+interpret mode off-TPU) to the same digests, and — like gate 7 —
+exits non-zero if the kernel was NOT actually selected: a silently
+refused probe must fail the gate rather than quietly re-test XLA
+against itself.
+
+Usage: JAX_PLATFORMS=cpu python tools/gnn_smoke.py
+"""
+
+import hashlib
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _digest(summaries, slab) -> str:
+    h = hashlib.sha256()
+    for s in summaries:
+        h.update(json.dumps(s, sort_keys=True).encode())
+    h.update(np.ascontiguousarray(slab, np.float32).tobytes())
+    return h.hexdigest()[:16]
+
+
+def _run(cls, eb, vb, F, src, dst):
+    from gelly_streaming_tpu.ops import gnn_window as gw
+
+    eng = cls(eb, vb, feature_dim=F)
+    rng = np.random.RandomState(3)
+    eng.set_weights(rng.randn(F, F) * 0.3, rng.randn(F) * 0.1)
+    eng.load_feature_units(gw.default_features(vb, F, seed=5))
+    out = eng.process(src, dst)
+    return _digest(out, eng.state()), eng
+
+
+def main() -> int:
+    os.environ.setdefault("GS_AUTOTUNE", "0")
+    from gelly_streaming_tpu.ops import gnn_window as gw
+    from gelly_streaming_tpu.ops import pallas_window as pw
+
+    eb = vb = 256
+    F = 16
+    rng = np.random.default_rng(42)
+    src = rng.integers(0, vb - 8, eb).astype(np.int32)
+    dst = rng.integers(0, vb - 8, eb).astype(np.int32)
+
+    want, _ = _run(gw.GnnHostEngine, eb, vb, F, src, dst)
+
+    os.environ["GS_GNN_PALLAS"] = "off"
+    pw._reset_pallas_window()
+    got, _ = _run(gw.GnnSummaryEngine, eb, vb, F, src, dst)
+    if got != want:
+        print("gnn_smoke: DIGEST MISMATCH device %s != host twin %s "
+              "(the lattice exactness contract is broken)"
+              % (got, want))
+        return 1
+
+    os.environ["GS_GNN_PALLAS"] = "on"
+    pw._reset_pallas_window()
+    peng = gw.GnnSummaryEngine(eb, vb, feature_dim=F)
+    if not peng._pallas:
+        print("gnn_smoke: fused GNN kernel NOT selected under "
+              "GS_GNN_PALLAS=on (build/trace probe refused — see "
+              "the durable selection.fallback event)")
+        return 1
+    pgot, _ = _run(gw.GnnSummaryEngine, eb, vb, F, src, dst)
+    os.environ.pop("GS_GNN_PALLAS", None)
+    pw._reset_pallas_window()
+    if pgot != want:
+        print("gnn_smoke: DIGEST MISMATCH pallas %s != host twin %s"
+              % (pgot, want))
+        return 1
+
+    print("gnn_smoke: ok (1 window, digest %s, xla ≡ pallas ≡ numpy "
+          "twin slab+summaries)" % want)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

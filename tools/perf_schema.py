@@ -55,6 +55,14 @@ LIST_SECTIONS = {
     # improvements), sliding_panes (pane-composed sliding reduce vs
     # the naive refold twin, bit-exact parity)
     "pump_ab": ("probe", "parity"),
+    # windowed GNN A/B (tools/gnn_ab.py): engine vs numpy twin and
+    # cohort vs N-sequential at sha256 feature-slab parity. Probes:
+    # gnn_engine (device scan vs host twin), gnn_cohort (vmapped
+    # N-tenant dispatch vs N sequential engines, one row per N),
+    # gnn_pallas (fused kernel vs XLA round — resolve_gnn_pallas's
+    # adoption evidence; off-chip rows must be interpret-marked, see
+    # _check_rows)
+    "gnn_ab": ("probe", "parity"),
     "autotune": ("engine", "parity"),
     "pipeline_stages": ("engine", "edge_bucket"),
     "chunk_deep": ("edge_bucket",),
@@ -107,6 +115,13 @@ DICT_SECTIONS = {
     "sanitize": ("engine", "parity", "overhead_ratio",
                  "disarmed_edges_per_s", "armed_edges_per_s",
                  "dlq_records", "quarantines"),
+    # windowed-GNN cost observatory rows (tools/profile_kernels.py
+    # section_gnn / tools/gnn_ab.py --commit): the per-program
+    # analytic cost rows for the MXU workload, with the stated
+    # arithmetic intensity beside the measured throughput so PERF.md
+    # shows whether the dense update moves the bound verdict off
+    # `bytes` — plus digest parity vs the host twin on the same run
+    "gnn": ("programs", "parity", "edge_bucket", "feature_dim"),
 }
 
 # per-row required keys of the cost_model section's `programs` list
@@ -119,7 +134,7 @@ _COST_PROGRAM_KEYS = ("program", "sig", "flops", "bytes_accessed",
 # A/B sections whose parity-true rows must claim a positive speedup
 # (the adoption gates divide by it; rows_clear_bar rejects otherwise)
 _AB_SECTIONS = ("ingress_ab", "egress_ab", "resident_ab",
-                "tenancy_ab", "pallas_ab", "pump_ab")
+                "tenancy_ab", "pallas_ab", "pump_ab", "gnn_ab")
 
 
 def _check_rows(name: str, rows, errors) -> None:
@@ -152,6 +167,14 @@ def _check_rows(name: str, rows, errors) -> None:
             errors.append(
                 "tenancy_ab[%d]: cohort_pallas row on backend %r "
                 "must carry interpret: true" % (i, row.get("backend")))
+        if name == "gnn_ab" \
+                and row.get("probe") == "gnn_pallas" \
+                and row.get("backend") != "tpu" \
+                and row.get("interpret") is not True:
+            # same contract for resolve_gnn_pallas's evidence rows
+            errors.append(
+                "gnn_ab[%d]: gnn_pallas row on backend %r must "
+                "carry interpret: true" % (i, row.get("backend")))
         if name == "degradations":
             ms = row.get("mesh_shape")
             if ms is not None and not (
@@ -198,26 +221,26 @@ def validate(perf) -> list:
                 if key not in val:
                     errors.append("%s: missing required key %r"
                                   % (name, key))
-            if name == "cost_model":
+            if name in ("cost_model", "gnn"):
                 rows = val.get("programs")
                 if not isinstance(rows, list):
                     if "programs" in val:
                         errors.append(
-                            "cost_model: 'programs' must be a list of "
-                            "rows, got %s" % type(rows).__name__)
+                            "%s: 'programs' must be a list of "
+                            "rows, got %s" % (name, type(rows).__name__))
                 else:
                     for i, row in enumerate(rows):
                         if not isinstance(row, dict):
                             errors.append(
-                                "cost_model.programs[%d]: expected a "
+                                "%s.programs[%d]: expected a "
                                 "dict row, got %s"
-                                % (i, type(row).__name__))
+                                % (name, i, type(row).__name__))
                             continue
                         for key in _COST_PROGRAM_KEYS:
                             if key not in row:
                                 errors.append(
-                                    "cost_model.programs[%d]: missing "
-                                    "required key %r" % (i, key))
+                                    "%s.programs[%d]: missing "
+                                    "required key %r" % (name, i, key))
     return errors
 
 
@@ -283,6 +306,12 @@ _CHAOS_LEGS = {
     # (overlap_feeds > 0: the leg proves the overlap path, not a
     # quietly serialized pump)
     "pump_leg": ("parity", "faults_fired", "overlap_feeds"),
+    # the windowed-GNN drill (ISSUE 19): fatal kill mid-stream on a
+    # checkpoint+WAL-armed GnnSummaryEngine, resume into a fresh
+    # engine, and the final feature slab + combined summaries must be
+    # digest-identical to the fault-free oracle (weights restored
+    # from the checkpoint's gnn section, never re-seeded)
+    "gnn_leg": ("parity", "faults_fired", "resumed_from_window"),
 }
 
 

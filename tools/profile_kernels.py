@@ -1533,6 +1533,18 @@ def section_cost_model(results: dict) -> None:
     }
 
 
+def section_gnn(results: dict) -> None:
+    """The windowed-GNN cost observatory (ops/gnn_window): the same
+    armed/disarmed evidence tools/gnn_ab.py --commit writes — digest
+    parity asserted (armed ≡ disarmed ≡ numpy twin, slab AND
+    summaries) before the analytic slab-model rows are kept. One
+    shared helper so the profiler and the A/B tool can never commit
+    divergent shapes for the same section."""
+    from tools.gnn_ab import gnn_cost_section
+
+    results["gnn"] = gnn_cost_section()
+
+
 def section_host_snapshot(results: dict) -> None:
     """Batched snapshot-analytics tiers: the driver's device scan vs
     the C++ carried union-find (native.snapshot_windows) — the
@@ -1797,6 +1809,9 @@ SECTIONS = {
     # cost_model AOT-compiles the fused-scan/resident programs once
     # more for their analyses: scan-class compiles, END of the order
     "cost_model": section_cost_model,
+    # gnn compiles the windowed-GNN scan on the acceptance shape:
+    # scan-class compile, END of the order beside cost_model
+    "gnn": section_gnn,
     "fused": section_fused,
     "driver": section_driver,
 }
