@@ -48,6 +48,7 @@ from ..utils import faults
 from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
+from ..utils import provenance
 from ..utils import resilience
 from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
@@ -1361,6 +1362,20 @@ class StreamingAnalyticsDriver:
                             "stages": dict(rec["stages"]),
                             "replayed": rec["replayed"],
                         }
+            if provenance.armed() and len(results) >= len(chunk):
+                # this chunk's finalize just appended its results;
+                # wal_lo/hi follow the edges_done cursor (== the
+                # checkpoint's wal_offset contract)
+                lane = self.tenant or "driver"
+                lo = self.edges_done
+                for i, (_w, s, _d, _n) in enumerate(chunk):
+                    res = results[len(results) - len(chunk) + i]
+                    provenance.emit(
+                        tenant=lane, window=self.windows_done + i,
+                        wal_lo=lo, wal_hi=lo + len(s),
+                        tier=tier, program="driver",
+                        digest=provenance.result_digest(res))
+                    lo += len(s)
             self.windows_done += len(chunk)
             self.edges_done += edges
             metrics.mark_window(len(chunk), edges, engine="driver",
@@ -2171,6 +2186,15 @@ class StreamingAnalyticsDriver:
                 res.latency = {"e2e_s": rec["e2e_s"],
                                "stages": dict(rec["stages"]),
                                "replayed": rec["replayed"]}
+        if provenance.armed():
+            provenance.emit(
+                tenant=self.tenant or "driver",
+                window=self.windows_done,
+                wal_lo=self.edges_done,
+                wal_hi=self.edges_done + len(src),
+                tier=self._demoted_tier or self._base_tier(),
+                program="driver",
+                digest=provenance.result_digest(res))
         self.windows_done += 1
         self.edges_done += len(src)
         metrics.mark_window(

@@ -79,6 +79,7 @@ import numpy as np
 from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
+from ..utils import provenance
 from ..utils import resilience
 from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
@@ -645,6 +646,21 @@ class StreamServer:
                     if rec is not None:
                         row["latency_s"] = round(rec["e2e_s"], 6)
                         row["queue_edges"] = int(queued)
+            if provenance.armed():
+                # the DELIVERY record: digest covers the summary only
+                # (never the armed-only latency keys), so it matches
+                # the compute tier's record for the same window; the
+                # span is the nominal eb-aligned window — the exact
+                # covered span (short final window) lives in the
+                # compute-tier record
+                eb = self.cohort.eb
+                for row in rows:
+                    provenance.emit(
+                        tenant=tid, window=row["window"],
+                        wal_lo=row["window"] * eb,
+                        wal_hi=(row["window"] + 1) * eb,
+                        tier="serve", program="serve",
+                        summary=row["summary"])
             out[tid] = rows
             self.results.setdefault(tid, []).extend(rows)
             self._stats["windows"] += len(rows)

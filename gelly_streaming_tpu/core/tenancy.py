@@ -103,6 +103,7 @@ from ..utils import faults
 from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
+from ..utils import provenance
 from ..utils import resilience
 from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
@@ -1061,12 +1062,16 @@ class TenantCohort:
 
         with telemetry.span("cohort.dispatch", tenants=len(real),
                             windows=sum(w for _t, _r, w, _n in real),
-                            edges=edges):
+                            edges=edges) as sp:
             faults.fire("cohort_dispatch",
                         tuple(t.tid for t, _r, _w, _n in real))
             new_carries, outs = resilience.call_guarded(
                 "dispatch", ("cohort", self._round_no), _dispatch,
                 retries=0)  # carry-mutating: deadline only, never re-run
+        # the program-identity tags wrap_jit bound inside the dispatch
+        # key the costmodel entry this span's bytes come from; pop
+        # BEFORE the redo kernels below can rebind them
+        tags = telemetry.pop_dispatch_tags()
         mats = tuple(np.array(x) for x in outs)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per dispatch)
         latency.stamp(st, "dispatch")  # device wait ends with the d2h
         mdeg, ncomp, odd, tri, ovf = mats
@@ -1104,6 +1109,15 @@ class TenantCohort:
             self._res[res_key] = {"nb": nb, "rows": sig,
                                   "carry": new_carries}
             self.resident_dispatches += 1
+        # per-tenant cost attribution: split this dispatch's measured
+        # wall seconds (and the program's modeled bytes) across the
+        # REAL rows proportionally by valid-edge count — pad rows
+        # attribute zero, and the shares reconcile exactly to the
+        # span's total (pinned by test)
+        metrics.attribute_dispatch(
+            sp.elapsed, [(t.tid, n) for t, _r, _w, n in real],
+            program=tags.get("program"), sig=tags.get("sig"))
+        prov_tier = "cohort_resident" if res_on else "cohort"
         for t, row, w, n in real:
             summaries = []
             for j in range(w):
@@ -1142,6 +1156,21 @@ class TenantCohort:
                         edges=min((j + 1) * self.eb, n) - j * self.eb,
                         st=st, ordinal=t.windows_done + j,
                         defer=self.defer_delivery)
+            if provenance.armed():
+                # the recorded span is the tenant's own WAL cursor
+                # (windows_done × eb — the checkpoint contract), so
+                # replay_window can stream exactly these edges back
+                # through the host twin and re-derive the digest
+                for j in range(w):
+                    lo = (t.windows_done + j) * self.eb
+                    provenance.emit(
+                        tenant=t.tid, window=t.windows_done + j,
+                        wal_lo=lo,
+                        wal_hi=lo + min((j + 1) * self.eb, n)
+                        - j * self.eb,
+                        tier=prov_tier, program="cohort_scan",
+                        sig=tags.get("sig"),
+                        summary=summaries[j])
             t.windows_done += w
             if n < w * self.eb:      # the final short window just cut
                 t.closed_partial = True
@@ -1404,8 +1433,12 @@ class TenantCohort:
                     t.closed = True
                 continue
             with telemetry.span("tenant.single", tenant=t.tid,
-                                edges=int(n)):
+                                edges=int(n)) as sp:
                 summaries = t.engine.process(src, dst)
+            # a demoted tenant owns its whole dispatch: 100% share
+            metrics.attribute_dispatch(
+                sp.elapsed, [(t.tid, int(n))],
+                program=telemetry.pop_dispatch_tags().get("program"))
             with self._qlock:
                 t.src = t.src[n:]
                 t.dst = t.dst[n:]
@@ -1463,8 +1496,12 @@ class TenantCohort:
                 src, dst = t.src[:n], t.dst[:n]
             try:
                 with telemetry.span("tenant.probation", tenant=t.tid,
-                                    edges=int(n)):
+                                    edges=int(n)) as sp:
                     summaries = t.engine.process(src, dst)
+                metrics.attribute_dispatch(
+                    sp.elapsed, [(t.tid, int(n))],
+                    program=telemetry.pop_dispatch_tags()
+                    .get("program"))
                 if any(s["max_degree"] < 0 or s["num_components"] < 0
                        or s["num_components"] > t.vb + 1
                        or s["triangles"] < 0 for s in summaries):
@@ -2074,10 +2111,19 @@ class GnnTenantCohort:
         # padded rows/windows are all-invalid and therefore inert
         # (the round's empty-window-holds rule); their summary rows
         # are dropped below
-        hs, ys = self._program(nb, wb)(
-            jnp.stack(carries), self._wdev, self._bdev,
-            jnp.asarray(src), jnp.asarray(dst), jnp.asarray(valid))
-        maxf, active, csum, nmsg = (np.array(y) for y in ys)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per pump round)
+        with telemetry.span("cohort.dispatch", tenants=len(batch),
+                            windows=sum(t[0] for t in taken.values())
+                            ) as sp:
+            hs, ys = self._program(nb, wb)(
+                jnp.stack(carries), self._wdev, self._bdev,
+                jnp.asarray(src), jnp.asarray(dst),
+                jnp.asarray(valid))
+            maxf, active, csum, nmsg = (np.array(y) for y in ys)  # gslint: disable=host-sync (sanctioned finalize boundary: the cohort's ONE batched d2h per pump round)
+        tags = telemetry.pop_dispatch_tags()
+        metrics.attribute_dispatch(
+            sp.elapsed,
+            [(tid, int(np.sum(taken[tid][3]))) for tid in batch],  # gslint: disable=host-sync (numpy-on-numpy: the host-built validity stack)
+            program=tags.get("program"), sig=tags.get("sig"))
         for i, tid in enumerate(batch):
             t = self._tenants[tid]
             t["carry"] = hs[i]
@@ -2091,6 +2137,17 @@ class GnnTenantCohort:
                     "msg_edges": int(nmsg[i, w]),  # gslint: disable=host-sync (numpy-on-numpy after the batched d2h)
                 })
             edges = int(np.sum(taken[tid][3]))  # gslint: disable=host-sync (numpy-on-numpy: the host-built validity stack)
+            if provenance.armed():
+                vrows = taken[tid][3]
+                for w in range(num_w):
+                    lo = (t["windows_done"] + w) * self.eb
+                    provenance.emit(
+                        tenant=tid,
+                        window=t["windows_done"] + w,
+                        wal_lo=lo,
+                        wal_hi=lo + int(np.sum(vrows[w])),  # gslint: disable=host-sync (numpy-on-numpy: the host-built validity stack)
+                        tier="gnn_cohort", program="gnn_round",
+                        summary=rows[len(rows) - num_w + w])
             t["windows_done"] += num_w
             metrics.mark_window(num_w, edges, engine="GnnTenantCohort",
                                 tier="gnn_cohort", tenant=tid)

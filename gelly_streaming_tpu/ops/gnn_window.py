@@ -73,6 +73,7 @@ from . import segment as seg_ops
 from ..utils import knobs
 from ..utils import latency
 from ..utils import metrics
+from ..utils import provenance
 from .scan_analytics import SummaryEngineBase
 
 Q_BITS = 5                    # storage grid 2^-Q_BITS (units of 1/32)
@@ -390,6 +391,20 @@ class GnnEngineBase(SummaryEngineBase):
                     edges=min(lo_w + self.eb, len(src)) - lo_w,
                     st=st, ordinal=self.windows_done + w,
                     defer=self._lat_defer)
+        if provenance.armed():
+            # same cursor arithmetic as the scan-family emitter: the
+            # recorded span is what replay must stream to re-derive
+            # exactly this summary (windows_done × eb contract)
+            tenant = self._lat_lane or self._wal_tenant
+            for w in range(f_real):
+                lo = (self.windows_done + w) * self.eb
+                lo_c = (f_at + w) * self.eb
+                n_w = min(lo_c + self.eb, len(src)) - lo_c
+                provenance.emit(
+                    tenant=tenant, window=self.windows_done + w,
+                    wal_lo=lo, wal_hi=lo + n_w,
+                    tier=self.METRICS_TIER, program="gnn_round",
+                    summary=out[len(out) - f_real + w])
         self.windows_done += f_real
         lo_e = f_at * self.eb
         metrics.mark_window(

@@ -46,6 +46,7 @@ from ..utils import checkpoint
 from ..utils import faults
 from ..utils import latency
 from ..utils import metrics
+from ..utils import provenance
 from ..utils import sanitize as sanitize_mod
 from ..utils import telemetry
 from ..utils import wal as wal_mod
@@ -624,6 +625,21 @@ class SummaryEngineBase:
                     edges=min(lo_w + self.eb, len(src)) - lo_w,
                     st=st, ordinal=self.windows_done + w,
                     defer=self._lat_defer)
+        if provenance.armed():
+            # one ledger record per finalized window, emitted at the
+            # SAME cursor arithmetic as the checkpoint's wal_offset
+            # contract (windows_done × eb) — replay across the
+            # recorded span re-derives exactly this summary
+            tenant = self._lat_lane or self._wal_tenant
+            for w in range(f_real):
+                lo = (self.windows_done + w) * self.eb
+                lo_c = (f_at + w) * self.eb
+                n_w = min(lo_c + self.eb, len(src)) - lo_c
+                provenance.emit(
+                    tenant=tenant, window=self.windows_done + w,
+                    wal_lo=lo, wal_hi=lo + n_w,
+                    tier=self.METRICS_TIER, program="fused_scan",
+                    summary=out[len(out) - f_real + w])
         self.windows_done += f_real
         # window-finalize mark (utils/metrics): throughput counters +
         # the staleness clock the health watchdog reads

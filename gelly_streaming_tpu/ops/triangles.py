@@ -652,9 +652,9 @@ def _fastest_sweep_row(eb: int, sweep_key: str, value_key: str,
                     for s in row.get(sweep_key, []) or []
                     if s.get("per_window_ms") and s.get(value_key)]
         if measured:
-            default = max(1, int(min(
+            default = max(1, int(min(  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
                 measured,
-                key=lambda s: s["per_window_ms"])[value_key]))  # gslint: disable=host-sync (committed-evidence JSON ints, no device value in sight)
+                key=lambda s: s["per_window_ms"])[value_key]))
     return default
 
 _TUNED_CHUNK = {}  # eb -> measured windows-per-dispatch  # gslint: disable=thread-shared (idempotent memo of committed PERF.json evidence)

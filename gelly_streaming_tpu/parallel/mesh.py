@@ -58,7 +58,7 @@ def make_mesh(n_devices: Optional[int] = None,
     devices = jax.devices()
     if n_devices is not None:
         devices = devices[:n_devices]
-    return Mesh(np.array(devices), (axis_name,))
+    return Mesh(np.array(devices), (axis_name,))  # gslint: disable=host-sync (device HANDLES into a mesh layout, no device value in sight)
 
 
 def edge_sharding(mesh: Mesh) -> NamedSharding:

@@ -605,6 +605,25 @@ register("GS_GNN_PALLAS", "str", "", choices=("on", "off", "auto"),
               "chip row lands",
          default_text="auto")
 
+# tenant observatory (utils/provenance.py, per-tenant attribution)
+register("GS_PROVENANCE", "bool", False,
+         help="arm the per-window provenance ledger "
+              "(`utils/provenance.py`): every finalize owner appends "
+              "a CRC-framed record (tenant, window, wal span, tier + "
+              "program, knob fingerprint, summary sha256) that "
+              "`tools/replay_window.py` re-derives and diffs on any "
+              "tier; disarmed (the default) every emit() is a no-op "
+              "and digests are bit-identical to a ledger-less build")
+register("GS_PROVENANCE_DIR", "path", None,
+         help="directory of the provenance ledger's "
+              "`prov_<n>.seg` segments; unset disarms emit() even "
+              "with GS_PROVENANCE=1 (nowhere durable to write)")
+register("GS_PROVENANCE_RETAIN", "int", 0, lo=0,
+         help="closed ledger segments kept behind the open one "
+              "(rotation uses GS_WAL_SEGMENT_BYTES); 0 = keep "
+              "everything — the audit-trail default; bound it only "
+              "when an external archiver drains the records")
+
 
 # ----------------------------------------------------------------------
 # docs rendering (README table; gslint R3 diffs it back)

@@ -504,6 +504,83 @@ def mark_window(windows: int, edges: int, engine: str = "driver",
     _maybe_serve()
 
 
+def attribute_dispatch(seconds: float, rows,
+                       program: Optional[str] = None,
+                       sig: Optional[str] = None):
+    """Per-tenant cost attribution of ONE cohort dispatch: split the
+    span's measured wall `seconds` (and, when the cost observatory is
+    armed, the dispatched program's modeled bytes) across `rows` —
+    `[(tenant, valid_edges), ...]`, one row per tenant the vmapped
+    dispatch carried — proportionally by per-row valid-edge counts.
+
+    The split RECONCILES exactly (DESIGN.md §24): pad/invalid rows
+    (edges == 0) attribute zero, and the last nonzero row absorbs the
+    floating-point residue, so the attributed shares sum to `seconds`
+    bit-for-bit — an aggregator can roll tenant rows back up to the
+    device total without drift (pinned by tests/test_provenance.py).
+
+    Feeds `gs_tenant_device_seconds` / `gs_tenant_attributed_bytes`
+    counters and the bounded per-tenant table the /healthz hot-tenant
+    scoring reads, all under the existing tenant cardinality collapse.
+    Returns `[(tenant, seconds_share, bytes_share), ...]` (the armed
+    introspection surface; None disarmed)."""
+    if not enabled():
+        return None
+    rows = [(str(t), int(n)) for t, n in rows]
+    total = sum(n for _t, n in rows)
+    seconds = float(seconds)
+    if total <= 0 or seconds < 0:
+        return None
+    bytes_total = None
+    if program is not None and costmodel.enabled():
+        progs = costmodel.programs()
+        entry = progs.get((program, sig)) if sig is not None else None
+        if entry is None:
+            # the dispatch tags may be unavailable at this boundary
+            # (popped by an inner pipeline) — any captured signature
+            # of the same program models the same per-call traffic
+            # shape at this cohort's fixed padding
+            for (p, _s), e in sorted(progs.items()):
+                if p == program:
+                    entry = e
+                    break
+        if entry is not None and entry.get("bytes_accessed"):
+            bytes_total = float(entry["bytes_accessed"])  # gslint: disable=host-sync (cost-ledger JSON number, no device value in sight)
+    nz = [i for i, (_t, n) in enumerate(rows) if n > 0]
+    last = nz[-1]
+    out = []
+    acc_s = 0.0
+    acc_b = 0.0
+    for i, (t, n) in enumerate(rows):
+        if n == 0:
+            out.append((t, 0.0, 0.0))
+            continue
+        if i == last:
+            s = seconds - acc_s
+            b = (bytes_total - acc_b) if bytes_total else 0.0
+        else:
+            s = seconds * (n / total)
+            acc_s += s
+            b = bytes_total * (n / total) if bytes_total else 0.0
+            acc_b += b
+        out.append((t, s, b))
+    reg = _reg()
+    with reg.lock:
+        for t, s, b in out:
+            if s == 0.0 and b == 0.0:
+                continue
+            key = reg.tenant_key(t)
+            info = reg.tenants.setdefault(key, {})
+            info["device_s"] = info.get("device_s", 0.0) + s
+            if b:
+                info["attr_bytes"] = info.get("attr_bytes", 0.0) + b
+            counter_inc("gs_tenant_device_seconds", s, tenant=key)
+            if b:
+                counter_inc("gs_tenant_attributed_bytes", b,
+                            tenant=key)
+    return out
+
+
 def check_staleness(now: Optional[float] = None) -> str:
     """The staleness watchdog body (called by the utils/healthz
     watchdog thread; `now` injectable for tests): no finalize within
@@ -569,6 +646,9 @@ def health_snapshot(now: Optional[float] = None) -> dict:
                     "windows": info.get("windows", 0),
                     "edges": info.get("edges", 0),
                     "tier": info.get("tier"),
+                    # per-tenant cost attribution (attribute_dispatch)
+                    "device_s": round(info.get("device_s", 0.0), 6),
+                    "attr_bytes": round(info.get("attr_bytes", 0.0)),
                     "last_finalize_age_s": (
                         None if info.get("last_finalize") is None
                         else round(now - info["last_finalize"], 3)),
@@ -590,7 +670,48 @@ def health_snapshot(now: Optional[float] = None) -> dict:
             snap[name] = provider()
         except Exception as e:  # gslint: disable=except-hygiene (a broken serving-layer provider must degrade to an error cell in the probe body, never crash the health endpoint itself)
             snap[name] = {"error": "%s: %s" % (type(e).__name__, e)}
+    snap["hot_tenants"] = hot_tenants(snap)
     return snap
+
+
+def hot_tenants(snap: dict, k: int = 8) -> list:
+    """Ranked top-K hot-tenant rows off one health snapshot: each
+    tenant's device-seconds SHARE (attribute_dispatch's table) joined
+    with the latency plane's per-tenant p99 against the SLO target —
+    `score = device_share + min(p99 / target, 1)` (the SLO term is 0
+    when the plane or the target is disarmed), so a tenant burning
+    the device OR burning the error budget surfaces first. This is
+    the placement-advisor signal the fleet router consumes
+    (tools/tenant_report.py renders it per process)."""
+    tens = snap.get("tenants") or {}
+    lat = snap.get("latency")
+    lanes = (lat.get("tenants") or {}) if isinstance(lat, dict) else {}
+    slo = lat.get("slo") if isinstance(lat, dict) else None
+    target = (slo or {}).get("target_p99_s") or 0.0
+    total_s = sum(row.get("device_s") or 0.0 for row in tens.values())
+    rows = []
+    for tid, row in tens.items():
+        share = ((row.get("device_s") or 0.0) / total_s
+                 if total_s > 0 else 0.0)
+        lane = lanes.get(tid) or {}
+        p99 = lane.get("e2e_p99_s")
+        score = share
+        if target > 0 and p99:
+            score += min(p99 / target, 1.0)
+        rows.append({
+            "tenant": tid,
+            "score": round(score, 6),
+            "device_share": round(share, 6),
+            "device_s": row.get("device_s", 0.0),
+            "attr_bytes": row.get("attr_bytes", 0),
+            "tier": row.get("tier"),
+            "e2e_p99_s": p99,
+            "queue_age_s": lane.get("queue_age_s"),
+            "burn_rate": (slo or {}).get("burn_rate"),
+            "stale": row.get("stale"),
+        })
+    rows.sort(key=lambda r: (-r["score"], r["tenant"]))
+    return rows[:k]
 
 
 def _maybe_serve() -> None:

@@ -155,7 +155,7 @@ def extract_rows(doc, label: str) -> dict:
                 str(row.get(k)) for k in keys))
             add(ident, row)
     for meta_key in ("telemetry_meta", "metrics", "latency",
-                     "sanitize"):
+                     "sanitize", "provenance"):
         meta = doc.get(meta_key)
         if isinstance(meta, dict):
             add(meta_key, meta)

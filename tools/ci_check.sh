@@ -55,6 +55,12 @@
 #      the numpy lattice twin — a broken lattice edit or a silently
 #      refused kernel probe fails the gate instead of passing
 #      vacuously
+#  13. provenance smoke (tools/provenance_smoke.py): an armed
+#      8-tenant cohort run must leave a provenance ledger in which
+#      EVERY record — one per finalized window — replays digest-exact
+#      through tools/replay_window.py on both the host twin and the
+#      fused scan tier (checkpoint + WAL span + recompute); a missing
+#      or unreplayable record fails, never silently skips
 #
 # Usage: tools/ci_check.sh [--skip-tests]
 #   --skip-tests  run only the static/evidence gates (seconds, not
@@ -63,45 +69,48 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 if [[ "${1:-}" != "--skip-tests" ]]; then
-  echo "== [1/12] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
+  echo "== [1/13] tier-1 pytest (JAX_PLATFORMS=cpu, -m 'not slow') =="
   JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
     --continue-on-collection-errors -p no:cacheprovider
 else
-  echo "== [1/12] tier-1 pytest SKIPPED (--skip-tests) =="
+  echo "== [1/13] tier-1 pytest SKIPPED (--skip-tests) =="
 fi
 
-echo "== [2/12] gslint =="
+echo "== [2/13] gslint =="
 python -m tools.gslint
 
-echo "== [3/12] perf_schema: committed PERF*/BENCH_* evidence =="
+echo "== [3/13] perf_schema: committed PERF*/BENCH_* evidence =="
 evidence=(PERF*.json BENCH_*.json logs/CHAOS_*.json)
 python tools/perf_schema.py "${evidence[@]}"
 
-echo "== [4/12] bench_compare self-compare (BENCH_r05.json) =="
+echo "== [4/13] bench_compare self-compare (BENCH_r05.json) =="
 python tools/bench_compare.py --baseline BENCH_r05.json > /dev/null
 
-echo "== [5/12] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
+echo "== [5/13] tenancy parity smoke (1-tenant cohort ≡ single stream) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --smoke
 
-echo "== [6/12] serve parity smoke (loopback + drain ≡ direct feed) =="
+echo "== [6/13] serve parity smoke (loopback + drain ≡ direct feed) =="
 JAX_PLATFORMS=cpu python tools/serve_smoke.py
 
-echo "== [7/12] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
+echo "== [7/13] pallas megakernel smoke (interpret ≡ XLA fused scan) =="
 JAX_PLATFORMS=cpu python tools/pallas_smoke.py
 
-echo "== [8/12] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
+echo "== [8/13] latency-plane smoke (waterfalls reconcile, armed ≡ disarmed) =="
 JAX_PLATFORMS=cpu python tools/latency_smoke.py
 
-echo "== [9/12] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
+echo "== [9/13] poison-input smoke (isolation + DLQ replay-exact re-injection) =="
 JAX_PLATFORMS=cpu python tools/poison_smoke.py
 
-echo "== [10/12] cohort-resident smoke (resident tier ≡ single streams, no silent decline) =="
+echo "== [10/13] cohort-resident smoke (resident tier ≡ single streams, no silent decline) =="
 JAX_PLATFORMS=cpu python tools/tenancy_ab.py --resident-smoke
 
-echo "== [11/12] async-pump smoke (async ≡ sync, real overlap; sliding pin) =="
+echo "== [11/13] async-pump smoke (async ≡ sync, real overlap; sliding pin) =="
 JAX_PLATFORMS=cpu python tools/pump_smoke.py
 
-echo "== [12/12] windowed-GNN smoke (device ≡ pallas ≡ numpy lattice twin) =="
+echo "== [12/13] windowed-GNN smoke (device ≡ pallas ≡ numpy lattice twin) =="
 JAX_PLATFORMS=cpu python tools/gnn_smoke.py
+
+echo "== [13/13] provenance smoke (every ledger record replays digest-exact on 2 tiers) =="
+JAX_PLATFORMS=cpu python tools/provenance_smoke.py
 
 echo "ci_check: all gates green"

@@ -559,6 +559,9 @@ class ThreadSharedRule(Rule):
         PKG + "/ops/resident_engine.py",
         PKG + "/utils/latency.py",
         PKG + "/ops/scan_analytics.py",
+        # the provenance ledger: every finalize owner appends — serve
+        # connection threads, the async pump, the driver (ISSUE 20)
+        PKG + "/utils/provenance.py",
     )
 
     def check_module(self, ctx: ModuleCtx) -> List[Finding]:

@@ -115,6 +115,16 @@ DICT_SECTIONS = {
     "sanitize": ("engine", "parity", "overhead_ratio",
                  "disarmed_edges_per_s", "armed_edges_per_s",
                  "dlq_records", "quarantines"),
+    # provenance-ledger overhead + truth proof (utils/provenance,
+    # tools/profile_kernels.py section_provenance): armed-vs-disarmed
+    # wall ratio at digest parity on the 524K/32768 row, every armed
+    # window's ledger digest asserted against the disarmed baseline
+    # summary, plus the per-tenant attribution rows whose seconds
+    # reconcile to the dispatch span — the committed evidence for the
+    # GS_PROVENANCE ≤1.02× bar (ISSUE 20)
+    "provenance": ("engine", "parity", "overhead_ratio",
+                   "disarmed_edges_per_s", "armed_edges_per_s",
+                   "records", "windows_verified", "attribution"),
     # windowed-GNN cost observatory rows (tools/profile_kernels.py
     # section_gnn / tools/gnn_ab.py --commit): the per-program
     # analytic cost rows for the MXU workload, with the stated
@@ -312,6 +322,14 @@ _CHAOS_LEGS = {
     # digest-identical to the fault-free oracle (weights restored
     # from the checkpoint's gnn section, never re-seeded)
     "gnn_leg": ("parity", "faults_fired", "resumed_from_window"),
+    # the provenance-ledger drill (ISSUE 20): a fully armed cohort
+    # (provenance + WAL + checkpoints) killed fatally mid-dispatch,
+    # recovered, and the re-emitted provenance records — including
+    # the at-least-once duplicates for replayed windows — must be
+    # byte-identical to the fault-free oracle's ledger (a crash can
+    # never fork the audit trail)
+    "provenance_leg": ("parity", "faults_fired", "records",
+                       "re_emitted"),
 }
 
 

@@ -1,0 +1,294 @@
+"""Replay one (or every) provenance-ledger window and diff digests —
+the migration/rebalance parity oracle as an operator command.
+
+Given a provenance record (utils/provenance.py: tenant, window
+ordinal, covered `wal_offset` span, tier, program, summary sha256),
+this tool re-derives the window from first principles:
+
+  1. load the nearest per-tenant checkpoint at or before the record's
+     `wal_lo` (cohort layout: `<ckpt-dir>/tenant_<id>.npz`, rotation
+     handled by utils/checkpoint.load_latest) — or start from a fresh
+     engine at offset 0 when none exists,
+  2. replay the WAL strictly across [checkpoint offset, wal_hi)
+     (utils/wal.replay trims to the exact boundary),
+  3. recompute on a CHOSEN tier — the host twin by default
+     (parallel/host_twin.HostSummaryEngine /
+     ops/gnn_window.GnnHostEngine: no compiler, no device), or the
+     fused scan tier (`--tier scan`) for a cross-tier check,
+  4. diff the recomputed summary's sha256 against the record's.
+
+A digest match proves the ledger record, the WAL span, and the
+checkpoint lineage agree bit-for-bit — the proof a fleet router needs
+before (and after) moving a tenant between hosts. Records this tool
+cannot replay are reported with an explicit reason, never silently
+skipped (tools/provenance_smoke.py turns a skip into a CI failure;
+`program=driver` records carry WindowResult-array digests and are
+verified by the driver's own kill→replay re-emission instead —
+tests/test_provenance.py).
+
+Usage:
+  python -m tools.replay_window --prov-dir DIR --wal-dir DIR \
+      [--ckpt-dir DIR] [--tenant T] [--window N] [--tier host|scan] \
+      [--eb N --vb N] [--json]
+
+Exit status: 0 = every selected record verified, 1 = any mismatch or
+unreplayable record.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from gelly_streaming_tpu.utils import checkpoint  # noqa: E402
+from gelly_streaming_tpu.utils import provenance  # noqa: E402
+from gelly_streaming_tpu.utils import wal as wal_mod  # noqa: E402
+
+_GNN_PROGRAMS = ("gnn_round",)
+
+
+def _safe_tid(tid: str) -> str:
+    # mirror of core/tenancy.TenantCohort._ckpt_path's sanitization
+    return "".join(c if c.isalnum() or c in "-_" else "_"
+                   for c in str(tid))
+
+
+def load_records(prov_dir: str, tenant=None, window=None, tier=None):
+    """The ledger's records, optionally filtered; duplicates for the
+    same (tenant, window, tier) key are collapsed to the LAST record
+    (at-least-once re-emission after a crash is expected and benign —
+    byte-identical by the ledger contract, pinned by tests)."""
+    sc = provenance.scan(prov_dir)
+    keyed = {}
+    for rec in sc["records"]:
+        if tenant is not None and rec["tenant"] != str(tenant):
+            continue
+        if window is not None and rec["window"] != int(window):
+            continue
+        if tier is not None and rec["tier"] != tier:
+            continue
+        keyed[(rec["tenant"], rec["window"], rec["tier"])] = rec
+    return [keyed[k] for k in sorted(keyed)], sc["torn"]
+
+
+def collect_span(wal_dir: str, tenant: str, lo: int, hi: int,
+                 clamp: bool = False):
+    """The tenant's journaled edges across [lo, hi) as (src, dst), or
+    None when the journal no longer covers the span (retention
+    truncated it and no checkpoint bridges the gap). `clamp=True`
+    accepts a journal that ends inside the span (serve-tier records
+    carry the NOMINAL eb-aligned window span, so a closed tenant's
+    short final window legitimately falls short of `hi`)."""
+    src_parts, dst_parts = [], []
+    have = lo
+    for tid, start, s, d, _ts in wal_mod.replay(wal_dir, {tenant: lo}):
+        if tid != tenant:
+            continue
+        if start > have:
+            return None  # a truncated prefix left a hole in the span
+        take = min(len(s), hi - have)
+        src_parts.append(s[:take])
+        dst_parts.append(d[:take])
+        have += take
+        if have >= hi:
+            break
+    if have < hi and not (clamp and have > lo):
+        return None
+    if not src_parts:
+        return (np.zeros(0, np.int64), np.zeros(0, np.int64))
+    return (np.concatenate(src_parts), np.concatenate(dst_parts))
+
+
+def _load_ckpt(ckpt, tenant):
+    """Resolve `ckpt` (None | state dict | .npz path | cohort ckpt
+    dir) to a tenant state dict or None."""
+    if ckpt is None:
+        return None
+    if isinstance(ckpt, dict):
+        return ckpt
+    path = ckpt
+    if os.path.isdir(path):
+        path = os.path.join(path, "tenant_%s.npz" % _safe_tid(tenant))
+    got = checkpoint.load_latest(path)
+    return got[0] if got is not None else None
+
+
+def _build_engine(rec, state, tier, eb, vb, kb):
+    """The replay engine for a record's family on the chosen tier —
+    (engine, start_offset) or (None, reason)."""
+    gnn = rec["program"] in _GNN_PROGRAMS
+    if state is not None:
+        if gnn:
+            from gelly_streaming_tpu.ops import gnn_window
+            cls = (gnn_window.GnnHostEngine if tier == "host"
+                   else gnn_window.GnnSummaryEngine)
+            eng = cls.from_state(state)
+        else:
+            from gelly_streaming_tpu.ops import scan_analytics
+            from gelly_streaming_tpu.parallel import host_twin
+            if tier == "host":
+                eng = host_twin.HostSummaryEngine.from_state(state)
+            else:
+                eng = scan_analytics.StreamSummaryEngine(
+                    edge_bucket=int(state["edge_bucket"]),
+                    vertex_bucket=int(state["vertex_bucket"]))
+                eng.load_state_dict(state)
+        return eng, int(state["wal_offset"])
+    if gnn:
+        # a fresh GNN engine has no layer weights — the checkpoint IS
+        # the weight source, so replay without one cannot be faithful
+        return None, "gnn record needs a checkpoint (layer weights)"
+    if not eb or not vb:
+        return None, ("no checkpoint found: pass --eb/--vb to replay "
+                      "from a fresh engine at offset 0")
+    from gelly_streaming_tpu.parallel import host_twin
+    from gelly_streaming_tpu.ops import scan_analytics
+    if tier == "host":
+        eng = host_twin.HostSummaryEngine(edge_bucket=eb,
+                                          vertex_bucket=vb)
+    else:
+        eng = scan_analytics.StreamSummaryEngine(
+            edge_bucket=eb, vertex_bucket=vb, k_bucket=kb)
+    return eng, 0
+
+
+def replay_record(rec, wal_dir, ckpt=None, tier="host",
+                  eb=None, vb=None, kb=0) -> dict:
+    """Replay ONE provenance record; returns a verdict row:
+    {"tenant", "window", "tier", "replay_tier", "ok", "recorded",
+     "computed", "skipped"} — `skipped` holds the reason a record
+    could not be replayed (and `ok` is False), so no record ever
+    disappears from the report."""
+    row = {"tenant": rec["tenant"], "window": rec["window"],
+           "tier": rec["tier"], "replay_tier": tier, "ok": False,
+           "recorded": rec["digest"], "computed": None,
+           "skipped": None}
+    if rec["program"] == "driver":
+        row["skipped"] = ("driver records digest WindowResult arrays; "
+                          "verify via the driver's kill->replay "
+                          "re-emission (tests/test_provenance.py)")
+        return row
+    state = _load_ckpt(ckpt, rec["tenant"])
+    if state is not None and (
+            int(state["wal_offset"]) > int(rec["wal_lo"])):
+        # the checkpoint is AHEAD of this (older) record: a fresh
+        # engine from offset 0 is the only faithful lineage left
+        state = None
+    eng, start = _build_engine(rec, state, tier, eb, vb, kb)
+    if eng is None:
+        row["skipped"] = start
+        return row
+    span = collect_span(wal_dir, rec["tenant"], start,
+                        int(rec["wal_hi"]),
+                        clamp=rec["program"] == "serve")
+    if span is None:
+        row["skipped"] = ("WAL no longer covers [%d, %d) for this "
+                          "tenant (retention?)"
+                          % (start, int(rec["wal_hi"])))
+        return row
+    # a replay is an audit READ: the recompute engine is itself a
+    # finalize owner, so disarm the ledger around it or every replay
+    # would append fresh records to the ledger it is auditing
+    prev = os.environ.get("GS_PROVENANCE")
+    os.environ["GS_PROVENANCE"] = "0"
+    try:
+        summaries = eng.process(*span)
+    finally:
+        if prev is None:
+            os.environ.pop("GS_PROVENANCE", None)
+        else:
+            os.environ["GS_PROVENANCE"] = prev
+    idx = int(rec["window"]) - start // eng.eb
+    if not 0 <= idx < len(summaries):
+        row["skipped"] = ("replay produced %d windows from offset %d; "
+                          "ordinal %d is out of range"
+                          % (len(summaries), start, rec["window"]))
+        return row
+    row["computed"] = provenance.summary_digest(summaries[idx])
+    row["ok"] = row["computed"] == rec["digest"]
+    return row
+
+
+def replay_all(prov_dir, wal_dir, ckpt=None, tier="host", eb=None,
+               vb=None, kb=0, tenant=None, window=None,
+               rec_tier=None) -> dict:
+    recs, torn = load_records(prov_dir, tenant=tenant, window=window,
+                              tier=rec_tier)
+    rows = [replay_record(r, wal_dir, ckpt=ckpt, tier=tier, eb=eb,
+                          vb=vb, kb=kb) for r in recs]
+    return {
+        "records": len(recs),
+        "verified": sum(1 for r in rows if r["ok"]),
+        "mismatched": sum(1 for r in rows
+                          if not r["ok"] and r["skipped"] is None),
+        "skipped": sum(1 for r in rows if r["skipped"] is not None),
+        "torn": torn,
+        "knob_fingerprint": provenance.knob_fingerprint(),
+        "rows": rows,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="replay provenance-ledger windows and diff "
+                    "digests (the tenant-migration parity oracle)")
+    ap.add_argument("--prov-dir", required=True,
+                    help="provenance ledger directory (prov_*.seg)")
+    ap.add_argument("--wal-dir", required=True,
+                    help="WAL journal directory (wal_*.seg)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="per-tenant checkpoint dir (tenant_<id>.npz) "
+                         "or one checkpoint .npz path")
+    ap.add_argument("--tenant", default=None,
+                    help="only this tenant's records")
+    ap.add_argument("--window", type=int, default=None,
+                    help="only this window ordinal")
+    ap.add_argument("--record-tier", default=None,
+                    help="only records emitted by this tier")
+    ap.add_argument("--tier", default="host",
+                    choices=("host", "scan"),
+                    help="tier to recompute on (default: host twin)")
+    ap.add_argument("--eb", type=int, default=None,
+                    help="edge bucket for fresh-engine replay (no "
+                         "checkpoint)")
+    ap.add_argument("--vb", type=int, default=None,
+                    help="vertex bucket for fresh-engine replay")
+    ap.add_argument("--kb", type=int, default=0,
+                    help="K bucket for fresh-engine replay")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args(argv)
+
+    rep = replay_all(args.prov_dir, args.wal_dir, ckpt=args.ckpt_dir,
+                     tier=args.tier, eb=args.eb, vb=args.vb,
+                     kb=args.kb, tenant=args.tenant,
+                     window=args.window, rec_tier=args.record_tier)
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        for r in rep["rows"]:
+            state = ("OK" if r["ok"] else
+                     "SKIP (%s)" % r["skipped"] if r["skipped"]
+                     else "MISMATCH")
+            print("%-12s w%-6d %-14s -> %-4s  %s"
+                  % (r["tenant"], r["window"], r["tier"],
+                     r["replay_tier"], state))
+        print("replayed %d record(s): %d verified, %d mismatched, "
+              "%d skipped%s"
+              % (rep["records"], rep["verified"], rep["mismatched"],
+                 rep["skipped"],
+                 "" if not rep["torn"] else
+                 " [torn tail: %s]" % rep["torn"]["problem"]))
+    bad = rep["mismatched"] + rep["skipped"]
+    return 1 if bad or not rep["records"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

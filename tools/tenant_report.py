@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Ranked per-tenant placement advisor over the tenant observatory.
+
+Joins the three tenant-grain planes one operator decision needs —
+*who is burning the device* (per-tenant cost attribution:
+`gs_tenant_device_seconds` / `gs_tenant_attributed_bytes`,
+utils/metrics.attribute_dispatch), *who is hurting* (the latency
+plane's per-tenant e2e p50/p95/p99, SLO burn rate, queue depth+age),
+and *who has history* (durable `quarantine` events in the telemetry
+ledger + the cohort's live quarantined list) — into one ranked table
+and a JSON document a fleet router can consume to decide which tenant
+to move first (pair with tools/replay_window.py to prove the move was
+bit-exact).
+
+Input is a `/healthz` body: a URL (fetched), a file path, or `-`
+(stdin) — the sections used are `tenants` (attribution rows),
+`hot_tenants` (the server-side top-K score), `latency.tenants`, and
+`serve.queues`/`serve.quarantined` when the serving layer is up.
+Quarantine HISTORY needs the flight-recorder ledger
+(GS_TRACE_DIR/events.jsonl): pass `--events` to count per-tenant
+`quarantine` records and surface the last reason.
+
+Usage:
+  python tools/tenant_report.py --healthz http://127.0.0.1:9100/healthz
+  python tools/tenant_report.py --healthz snap.json --events ledger.jsonl \
+      [--top 10] [--json]
+
+Exit status: 0 = report rendered, 2 = no tenant data in the body.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_body(src: str) -> dict:
+    if src == "-":
+        return json.load(sys.stdin)
+    if src.startswith("http://") or src.startswith("https://"):
+        from urllib.request import urlopen
+
+        with urlopen(src, timeout=10) as r:
+            return json.loads(r.read().decode())
+    with open(src) as f:
+        return json.load(f)
+
+
+def quarantine_history(events_path: str) -> dict:
+    """{tenant: {"count", "last_reason", "last_windows_done"}} from
+    the ledger's durable `quarantine` events (torn final line
+    tolerated — the telemetry reader discipline)."""
+    hist = {}
+    with open(events_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue  # torn tail
+            if rec.get("t") != "event" \
+                    or rec.get("name") != "quarantine":
+                continue
+            a = rec.get("a") or {}
+            tid = str(a.get("tenant"))
+            h = hist.setdefault(tid, {"count": 0, "last_reason": None,
+                                      "last_windows_done": None})
+            h["count"] += 1
+            h["last_reason"] = a.get("reason")
+            h["last_windows_done"] = a.get("windows_done")
+    return hist
+
+
+def build_report(body: dict, hist=None, top: int = 0) -> dict:
+    """The placement table: one row per tenant, ranked by the
+    hot-tenant score (server-side when the body carries
+    `hot_tenants`, else recomputed from device-seconds share)."""
+    tenants = body.get("tenants") or {}
+    lat = (body.get("latency") or {}).get("tenants") or {}
+    serve = body.get("serve") or {}
+    queues = serve.get("queues") or {}
+    quarantined = set(serve.get("quarantined") or ())
+    hot = {r["tenant"]: r for r in body.get("hot_tenants") or ()}
+    hist = hist or {}
+
+    total_s = sum(float(v.get("device_s") or 0.0)
+                  for v in tenants.values())
+    rows = []
+    for tid in sorted(set(tenants) | set(lat) | set(hot)):
+        t = tenants.get(tid) or {}
+        l = lat.get(tid) or {}
+        h = hot.get(tid) or {}
+        q = queues.get(tid) or {}
+        dev_s = float(t.get("device_s") or h.get("device_s") or 0.0)
+        share = (dev_s / total_s) if total_s > 0 else 0.0
+        score = h.get("score")
+        if score is None:
+            score = share  # body predates hot_tenants: share-ranked
+        qh = hist.get(tid) or {}
+        rows.append({
+            "tenant": tid,
+            "score": round(float(score), 6),
+            "device_share": round(share, 6),
+            "device_s": round(dev_s, 6),
+            "attr_bytes": int(t.get("attr_bytes")
+                              or h.get("attr_bytes") or 0),
+            "tier": t.get("tier") or h.get("tier"),
+            "windows": t.get("windows") or l.get("windows"),
+            "e2e_p99_s": l.get("e2e_p99_s"),
+            "burn_rate": h.get("burn_rate"),
+            "queue_edges": q.get("edges"),
+            "queue_age_s": q.get("age_s") or h.get("queue_age_s"),
+            "quarantined": tid in quarantined,
+            "quarantines": int(qh.get("count") or 0),
+            "last_quarantine_reason": qh.get("last_reason"),
+        })
+    rows.sort(key=lambda r: (-r["score"], r["tenant"]))
+    if top:
+        rows = rows[:top]
+    return {
+        "status": body.get("status"),
+        "total_device_s": round(total_s, 6),
+        "tenants": rows,
+    }
+
+
+def render(rep: dict) -> str:
+    cols = ("tenant", "score", "dev%", "device_s", "MBytes",
+            "p99_s", "burn", "q_edges", "q_age_s", "tier", "quar")
+    lines = ["%-12s %7s %6s %9s %8s %8s %6s %8s %8s %-10s %s"
+             % cols]
+    for r in rep["tenants"]:
+        def f(v, fmt="%s"):
+            return "-" if v is None else fmt % v
+        quar = ("NOW" if r["quarantined"]
+                else str(r["quarantines"]) if r["quarantines"]
+                else "-")
+        lines.append(
+            "%-12s %7.3f %5.1f%% %9.4f %8s %8s %6s %8s %8s %-10s %s"
+            % (r["tenant"][:12], r["score"],
+               100.0 * r["device_share"], r["device_s"],
+               f(round(r["attr_bytes"] / 1e6, 1) if r["attr_bytes"]
+                 else None),
+               f(r["e2e_p99_s"], "%.4f"), f(r["burn_rate"], "%.2f"),
+               f(r["queue_edges"]), f(r["queue_age_s"], "%.3f"),
+               f(r["tier"]), quar))
+    lines.append("total attributed device seconds: %.4f"
+                 % rep["total_device_s"])
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="ranked per-tenant placement advisor "
+                    "(cost attribution x latency x quarantine)")
+    ap.add_argument("--healthz", required=True,
+                    help="/healthz URL, JSON file path, or '-'")
+    ap.add_argument("--events", default=None,
+                    help="telemetry events.jsonl for quarantine "
+                         "history")
+    ap.add_argument("--top", type=int, default=0,
+                    help="limit to the K hottest tenants")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the router-consumable JSON document")
+    args = ap.parse_args(argv)
+
+    body = load_body(args.healthz)
+    hist = quarantine_history(args.events) if args.events else None
+    rep = build_report(body, hist=hist, top=args.top)
+    if not rep["tenants"]:
+        print("no tenant data in the /healthz body (is GS_METRICS=1 "
+              "set, and has a window finalized?)", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
